@@ -1,0 +1,17 @@
+"""sm_distributed_tpu — TPU-native spatial-metabolomics annotation engine.
+
+A from-scratch, TPU-first (JAX / XLA / pjit / Pallas) framework with the
+capabilities of the METASPACE annotation engine (reference:
+``frulo/SM_distributed``, see SURVEY.md): FDR-controlled molecular annotation
+of imaging-mass-spectrometry (imzML) datasets.
+
+Where the reference runs ion-image extraction and MSM scoring as a Spark-RDD
+pipeline over a CPU cluster (``sm/engine/msm_basic/*`` [U]), this framework
+holds the (pixels x m/z) spectral cube as a mesh-sharded device array,
+precomputes theoretical isotope patterns into a device-resident tensor, and
+runs extraction -> scoring -> target/decoy FDR as one fused XLA graph vmapped
+over formula batches, selectable behind a config-level backend switch
+(``backend: numpy_ref | jax_tpu``) with the NumPy backend as parity oracle.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,173 @@
+"""Two-level configuration, mirroring the reference's config system.
+
+The reference uses a process-global ``SMConfig`` singleton loading
+``conf/config.json`` (services + spark + fdr settings) and a per-dataset
+``ds_config.json`` (database, isotope_generation, image_generation) —
+``sm/engine/util.py::SMConfig`` [U], SURVEY.md #1/#20.  Every numerical knob
+keeps its reference name and default: ``ppm``, ``nlevels=30``, ``q=99``,
+``do_preprocessing``, ``decoy_sample_size=20``, ``isocalc_sigma``,
+``isocalc_pts_per_mz``, ``adducts``, ``charge``.
+
+One deliberate addition, demanded by the north star (BASELINE.json): the
+``backend`` selector — ``numpy_ref`` (CPU parity oracle, the stand-in for the
+reference's Spark-RDD executor) or ``jax_tpu`` (the fused-XLA-graph TPU path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, ClassVar
+
+VALID_BACKENDS = ("numpy_ref", "jax_tpu")
+
+
+def _from_dict(cls, d: dict[str, Any]):
+    """Build a dataclass from a dict, recursing into dataclass fields and
+    rejecting unknown keys (catches config typos early, unlike the reference's
+    raw-dict access which fails deep inside a Spark task)."""
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(d) - names
+    if unknown:
+        raise ValueError(f"unknown {cls.__name__} config keys: {sorted(unknown)}")
+    kwargs = {}
+    for key, val in d.items():
+        target = _DATACLASS_FIELDS.get((cls.__name__, key))
+        if target is not None and isinstance(val, dict):
+            kwargs[key] = _from_dict(target, val)
+        elif isinstance(val, list):
+            # JSON arrays land in tuple-typed fields; keep frozen configs hashable.
+            kwargs[key] = tuple(val)
+        else:
+            kwargs[key] = val
+    return cls(**kwargs)
+
+
+@dataclass(frozen=True)
+class IsotopeGenerationConfig:
+    """Mirrors ds_config['isotope_generation'] [U]."""
+    adducts: tuple[str, ...] = ("+H", "+Na", "+K")
+    charge: int = 1                      # signed; reference: {polarity:'+', n_charges:1}
+    isocalc_sigma: float = 0.01          # gaussian sigma of instrument blur [Da]
+    isocalc_pts_per_mz: int = 10000      # resolution of the profile grid
+    n_peaks: int = 4                     # top isotope peaks kept per ion (reference: 4)
+
+    def __post_init__(self):
+        if self.charge == 0:
+            raise ValueError("isotope_generation.charge must be nonzero")
+        if self.isocalc_sigma <= 0 or self.isocalc_pts_per_mz <= 0 or self.n_peaks <= 0:
+            raise ValueError("isotope_generation: sigma/pts_per_mz/n_peaks must be positive")
+
+
+@dataclass(frozen=True)
+class ImageGenerationConfig:
+    """Mirrors ds_config['image_generation'] [U]."""
+    ppm: float = 3.0                     # half-width of the m/z match window
+    nlevels: int = 30                    # thresholds in measure_of_chaos
+    do_preprocessing: bool = False       # hot-spot removal before chaos
+    q: float = 99.0                      # hot-spot clipping percentile
+
+    def __post_init__(self):
+        if self.ppm <= 0 or self.nlevels <= 0 or not (0 < self.q <= 100):
+            raise ValueError("image_generation: ppm/nlevels/q out of range")
+
+
+@dataclass(frozen=True)
+class DatabaseConfig:
+    """Mirrors ds_config['database'] [U]."""
+    name: str = "HMDB"
+    version: str = "2016"
+
+
+@dataclass(frozen=True)
+class DSConfig:
+    """Per-dataset config (the reference's ds_config.json [U])."""
+    database: DatabaseConfig = field(default_factory=DatabaseConfig)
+    isotope_generation: IsotopeGenerationConfig = field(default_factory=IsotopeGenerationConfig)
+    image_generation: ImageGenerationConfig = field(default_factory=ImageGenerationConfig)
+
+    @staticmethod
+    def load(path: str | Path) -> "DSConfig":
+        return _from_dict(DSConfig, json.loads(Path(path).read_text()))
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "DSConfig":
+        return _from_dict(DSConfig, d)
+
+
+@dataclass(frozen=True)
+class FDRConfig:
+    """Mirrors sm_config['fdr'] [U]."""
+    decoy_sample_size: int = 20
+    seed: int = 42                       # decoy sampling made explicit/seeded (SURVEY §7 hard part 3)
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """TPU-native replacement for sm_config['spark'] [U]: mesh geometry instead
+    of master/executor-memory. axis sizes of -1 mean 'use all devices'."""
+    pixels_axis: int = -1                # mesh axis sharding the pixel dimension
+    formulas_axis: int = 1               # mesh axis sharding the formula dimension
+    formula_batch: int = 512             # ions scored per fused-graph invocation
+    mz_chunk: int = 0                    # 0 = no m/z chunking inside the kernel
+
+
+@dataclass(frozen=True)
+class StorageConfig:
+    """Replaces sm_config['db'/'elasticsearch'] service blocks: pluggable local
+    sinks (parquet results + sqlite index) instead of Postgres/ES."""
+    results_dir: str = "results"
+    store_images: bool = True
+    image_format: str = "npz"            # npz (sparse) | png
+
+
+@dataclass(frozen=True)
+class SMConfig:
+    """Engine-global config (the reference's conf/config.json via
+    sm/engine/util.py::SMConfig [U])."""
+    backend: str = "jax_tpu"
+    fdr: FDRConfig = field(default_factory=FDRConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    work_dir: str = "/tmp/sm_tpu_work"
+    logs_dir: str = ""                   # "" = console only
+
+    def __post_init__(self):
+        if self.backend not in VALID_BACKENDS:
+            raise ValueError(f"backend must be one of {VALID_BACKENDS}, got {self.backend!r}")
+
+    # -- singleton access, mirroring SMConfig.set_path()/get_conf() [U] --
+    _instance: ClassVar["SMConfig | None"] = None
+
+    @staticmethod
+    def set_path(path: str | Path) -> "SMConfig":
+        SMConfig._instance = _from_dict(SMConfig, json.loads(Path(path).read_text()))
+        return SMConfig._instance
+
+    @staticmethod
+    def set(conf: "SMConfig") -> "SMConfig":
+        SMConfig._instance = conf
+        return conf
+
+    @staticmethod
+    def get_conf() -> "SMConfig":
+        if SMConfig._instance is None:
+            SMConfig._instance = SMConfig()
+        return SMConfig._instance
+
+    @staticmethod
+    def from_dict(d: dict[str, Any]) -> "SMConfig":
+        return _from_dict(SMConfig, d)
+
+
+# nested-field -> dataclass routing for _from_dict
+_DATACLASS_FIELDS = {
+    ("DSConfig", "database"): DatabaseConfig,
+    ("DSConfig", "isotope_generation"): IsotopeGenerationConfig,
+    ("DSConfig", "image_generation"): ImageGenerationConfig,
+    ("SMConfig", "fdr"): FDRConfig,
+    ("SMConfig", "parallel"): ParallelConfig,
+    ("SMConfig", "storage"): StorageConfig,
+}

@@ -1,0 +1,51 @@
+"""Logging setup, mirroring ``sm/engine/util.py::init_logger`` + conf/sm_log.cfg [U].
+
+One engine-wide logger named ``sm-tpu`` (the reference's is ``sm-engine``),
+console + optional file handler, phase-timing helper used by the orchestrator
+for the reference's step-level wall-clock logging (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from pathlib import Path
+
+LOGGER_NAME = "sm-tpu"
+_FMT = "%(asctime)s - %(levelname)s - %(name)s - %(message)s"
+
+
+def init_logger(logs_dir: str | None = None, level: int = logging.INFO) -> logging.Logger:
+    logger = logging.getLogger(LOGGER_NAME)
+    logger.setLevel(level)
+    if not logger.handlers:
+        sh = logging.StreamHandler()
+        sh.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(sh)
+    if logs_dir:
+        path = Path(logs_dir)
+        path.mkdir(parents=True, exist_ok=True)
+        if not any(isinstance(h, logging.FileHandler) for h in logger.handlers):
+            fh = logging.FileHandler(path / "sm-tpu.log")
+            fh.setFormatter(logging.Formatter(_FMT))
+            logger.addHandler(fh)
+    return logger
+
+
+logger = logging.getLogger(LOGGER_NAME)
+
+
+@contextlib.contextmanager
+def phase_timer(phase: str, timings: dict[str, float] | None = None):
+    """Log wall-clock of a pipeline phase (the reference logs around each
+    SearchJob phase [U]); optionally record into a timings dict for bench/trace."""
+    t0 = time.perf_counter()
+    logger.info("phase %s ...", phase)
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        logger.info("phase %s done in %.3fs", phase, dt)
+        if timings is not None:
+            timings[phase] = timings.get(phase, 0.0) + dt

@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+The reference tests its "distributed" code on a single machine by running the
+real engine in Spark ``local[*]`` mode (SURVEY.md §4).  The TPU-native analog:
+run the real JAX engine on a virtual 8-device CPU mesh —
+``--xla_force_host_platform_device_count=8`` — so sharding/collective code
+paths execute for real without TPU hardware.  These env vars must be set
+before jax is imported anywhere, hence this top-of-conftest block.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_config_singleton():
+    """Isolate the SMConfig process-global between tests."""
+    from sm_distributed_tpu.utils.config import SMConfig
+
+    SMConfig._instance = None
+    yield
+    SMConfig._instance = None

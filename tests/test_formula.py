@@ -1,0 +1,143 @@
+"""Unit tests for sum-formula/adduct parsing (reference analog: the parsing
+half of tests/test_isocalc_wrapper.py [U], SURVEY.md §4 pure-unit row)."""
+
+import pytest
+
+from sm_distributed_tpu.ops import elements
+from sm_distributed_tpu.ops.formula import (
+    FormulaError,
+    apply_adduct,
+    format_formula,
+    ion_mz,
+    monoisotopic_mass,
+    parse_adduct,
+    parse_formula,
+)
+
+
+def test_parse_simple():
+    assert parse_formula("C6H12O6") == {"C": 6, "H": 12, "O": 6}
+    assert parse_formula("H2O") == {"H": 2, "O": 1}
+    assert parse_formula("CH4") == {"C": 1, "H": 4}
+    assert parse_formula("NaCl") == {"Na": 1, "Cl": 1}
+
+
+def test_parse_two_letter_elements():
+    assert parse_formula("C27H46ClNO2") == {"C": 27, "H": 46, "Cl": 1, "N": 1, "O": 2}
+    assert parse_formula("Se") == {"Se": 1}
+
+
+def test_parse_parentheses():
+    assert parse_formula("Ca(NO3)2") == {"Ca": 1, "N": 2, "O": 6}
+    assert parse_formula("(CH3)3N") == {"C": 3, "H": 9, "N": 1}
+
+
+def test_parse_errors():
+    with pytest.raises(FormulaError):
+        parse_formula("")
+    with pytest.raises(FormulaError):
+        parse_formula("C6H12O6)")
+    with pytest.raises(FormulaError):
+        parse_formula("(C6H12O6")
+    with pytest.raises(FormulaError):
+        parse_formula("Xx2")  # unknown element
+    with pytest.raises(FormulaError):
+        parse_formula("c6")  # lowercase start
+
+
+def test_adducts():
+    assert parse_adduct("+H") == (1, {"H": 1})
+    assert parse_adduct("-H") == (-1, {"H": 1})
+    assert apply_adduct({"C": 6, "H": 12, "O": 6}, "+Na") == {"C": 6, "H": 12, "O": 6, "Na": 1}
+    assert apply_adduct({"C": 6, "H": 12, "O": 6}, "-H") == {"C": 6, "H": 11, "O": 6}
+    with pytest.raises(FormulaError):
+        apply_adduct({"C": 1, "H": 4}, "-O")
+    with pytest.raises(FormulaError):
+        parse_adduct("H")
+
+
+def test_monoisotopic_masses():
+    # Hand-checked exact masses.
+    assert monoisotopic_mass(parse_formula("H2O")) == pytest.approx(18.0105646863, abs=1e-6)
+    assert monoisotopic_mass(parse_formula("C6H12O6")) == pytest.approx(180.0633881, abs=1e-5)
+    assert monoisotopic_mass(parse_formula("CH4")) == pytest.approx(16.0313001, abs=1e-6)
+
+
+def test_ion_mz_accounts_for_electron():
+    counts = apply_adduct(parse_formula("C6H12O6"), "+H")
+    mz = ion_mz(counts, charge=1)
+    # [M+H]+ of glucose = 181.070665 (M + 1.007276 proton mass)
+    assert mz == pytest.approx(181.070665, abs=1e-5)
+    neutral = monoisotopic_mass(counts)
+    assert mz < neutral  # electron removed for positive ion
+
+
+def test_format_formula_hill_order():
+    assert format_formula({"O": 6, "C": 6, "H": 12}) == "C6H12O6"
+    assert format_formula({"Cl": 1, "Na": 1}) == "ClNa"
+    assert format_formula({"H": 1}) == "H"
+    # carbon-free: strictly alphabetical (Hill), H not promoted
+    assert format_formula({"H": 1, "Cl": 1}) == "ClH"
+
+
+def test_zero_counts_rejected():
+    with pytest.raises(FormulaError):
+        parse_formula("C0")
+    with pytest.raises(FormulaError):
+        parse_formula("H(CO3)0")
+
+
+def test_config_tuple_coercion():
+    from sm_distributed_tpu.utils.config import DSConfig
+
+    ds = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H", "+Na"]}})
+    assert ds.isotope_generation.adducts == ("+H", "+Na")
+    hash(ds.isotope_generation)  # frozen config stays hashable
+
+
+def test_isotope_table_sane():
+    # Abundances sum to ~1, masses ascending, for every element.
+    for el, isos in elements.ISOTOPES.items():
+        total = sum(a for _, a in isos)
+        assert abs(total - 1.0) < 5e-3, f"{el} abundance sum {total}"
+        masses = [m for m, _ in isos]
+        assert masses == sorted(masses), f"{el} masses not ascending"
+
+
+def test_config_roundtrip(tmp_path):
+    from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+    cfg = SMConfig.get_conf()
+    assert cfg.backend == "jax_tpu"
+    assert cfg.fdr.decoy_sample_size == 20
+
+    p = tmp_path / "conf.json"
+    p.write_text('{"backend": "numpy_ref", "fdr": {"decoy_sample_size": 5}}')
+    cfg2 = SMConfig.set_path(p)
+    assert cfg2.backend == "numpy_ref"
+    assert cfg2.fdr.decoy_sample_size == 5
+    assert SMConfig.get_conf() is cfg2
+
+    ds = DSConfig.from_dict(
+        {
+            "database": {"name": "HMDB", "version": "4"},
+            "isotope_generation": {"adducts": ["+H"], "charge": 1},
+            "image_generation": {"ppm": 2.0},
+        }
+    )
+    assert ds.image_generation.nlevels == 30
+    assert ds.image_generation.ppm == 2.0
+    assert ds.isotope_generation.isocalc_pts_per_mz == 10000
+
+
+def test_config_rejects_unknown_keys_and_bad_values(tmp_path):
+    from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+    with pytest.raises(ValueError):
+        SMConfig.from_dict({"backennd": "jax_tpu"})
+    with pytest.raises(ValueError):
+        SMConfig.from_dict({"backend": "spark"})
+    with pytest.raises(ValueError):
+        DSConfig.from_dict({"image_generation": {"ppm": -1}})
+    with pytest.raises(ValueError):
+        DSConfig.from_dict({"isotope_generation": {"charge": 0}})
