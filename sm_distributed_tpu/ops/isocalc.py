@@ -1,0 +1,287 @@
+"""Theoretical isotope-pattern calculation (the reference's IsocalcWrapper).
+
+Reference: ``sm/engine/isocalc_wrapper.py::IsocalcWrapper.isotope_peaks`` [U]
+(SURVEY.md #6) wraps ``pyMSpec.pyisocalc``: exact isotopic fine structure →
+gaussian blur at instrument resolution (``isocalc_sigma``,
+``isocalc_pts_per_mz``) → centroid detection → top-``n_peaks`` centroided
+(mzs[], ints[]) per (formula, adduct), intensities normalized to max=100.
+
+We implement the same algorithm natively on NumPy (host-side precompute; the
+result is packed into a device-resident tensor, see ``IsotopePatternTable``).
+The per-(config) disk cache plays the role of the reference's ``theor_peaks``
+Postgres table — a persistent cross-job cache where only missing
+(formula, adduct) pairs are recomputed (``theor_peaks_gen.py`` [U],
+SURVEY.md #7 and §5.4).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from . import elements
+from .formula import FormulaError, apply_adduct, parse_formula
+from ..utils.config import IsotopeGenerationConfig
+
+# fine-structure pruning: drop states below this relative abundance
+_PRUNE_ABUNDANCE = 1e-10
+# merge fine-structure states closer than this [Da] (well below any
+# instrument sigma we blur with; keeps convolutions small)
+_MERGE_DA = 1e-5
+# cap on states kept per convolution (keeps worst-case formulas bounded)
+_MAX_STATES = 4096
+
+
+def _merge_states(masses: np.ndarray, abunds: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Sort by mass; merge states within _MERGE_DA (abundance-weighted mass)."""
+    order = np.argsort(masses)
+    masses, abunds = masses[order], abunds[order]
+    # group indices: new group wherever the gap exceeds the merge width
+    group = np.concatenate([[0], np.cumsum(np.diff(masses) > _MERGE_DA)])
+    n = group[-1] + 1
+    ab = np.zeros(n)
+    np.add.at(ab, group, abunds)
+    wm = np.zeros(n)
+    np.add.at(wm, group, masses * abunds)
+    return wm / ab, ab
+
+
+def _prune(masses: np.ndarray, abunds: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    keep = abunds > _PRUNE_ABUNDANCE * abunds.max()
+    masses, abunds = masses[keep], abunds[keep]
+    if masses.size > _MAX_STATES:
+        keep = np.argsort(abunds)[-_MAX_STATES:]
+        keep.sort()
+        masses, abunds = masses[keep], abunds[keep]
+    return masses, abunds
+
+
+def _convolve(a: tuple[np.ndarray, np.ndarray], b: tuple[np.ndarray, np.ndarray]):
+    m = (a[0][:, None] + b[0][None, :]).ravel()
+    p = (a[1][:, None] * b[1][None, :]).ravel()
+    return _prune(*_merge_states(m, p))
+
+
+def _element_distribution(el: str, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """Isotope distribution of n atoms of el, by exponentiation-by-squaring."""
+    isos = elements.ISOTOPES[el]
+    base = (np.array([m for m, _ in isos]), np.array([a for _, a in isos]))
+    result: tuple[np.ndarray, np.ndarray] | None = None
+    sq = base
+    while n > 0:
+        if n & 1:
+            result = sq if result is None else _convolve(result, sq)
+        n >>= 1
+        if n:
+            sq = _convolve(sq, sq)
+    assert result is not None
+    return result
+
+
+def fine_structure(counts: dict[str, int]) -> tuple[np.ndarray, np.ndarray]:
+    """Exact isotopic fine structure of a neutral molecule: (masses, abundances),
+    sorted by mass, abundances summing to ~1 (minus pruned tail)."""
+    acc: tuple[np.ndarray, np.ndarray] | None = None
+    for el, n in sorted(counts.items()):
+        dist = _element_distribution(el, n)
+        acc = dist if acc is None else _convolve(acc, dist)
+    assert acc is not None
+    return acc
+
+
+def centroids(
+    counts: dict[str, int],
+    charge: int,
+    isocalc_sigma: float,
+    isocalc_pts_per_mz: int,
+    n_peaks: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Centroided theoretical pattern of the ION with the given atom counts.
+
+    Returns (mzs, ints): up to ``n_peaks`` peaks sorted by m/z ascending,
+    intensities normalized so the strongest peak is 100.0 (the pyisocalc
+    convention the reference stores in theor_peaks [U]).
+    """
+    masses, abunds = fine_structure(counts)
+    # ion m/z per fine-structure state
+    mzs_fs = (masses - charge * elements.ELECTRON_MASS) / abs(charge)
+
+    # Only the low-mass end can contribute the top peaks: blurring merges
+    # states within ~sigma, and isotope peaks are ~1/|z| apart. Keep a margin
+    # of n_peaks+2 isotope spacings above the monoisotopic state.
+    lo = mzs_fs.min()
+    window = (n_peaks + 2) / abs(charge)
+    keep = mzs_fs <= lo + window
+    mzs_fs, abunds_fs = mzs_fs[keep], abunds[keep]
+
+    # profile grid at pts_per_mz resolution, padded by 5 sigma
+    pad = 5.0 * isocalc_sigma
+    step = 1.0 / isocalc_pts_per_mz
+    grid_lo = mzs_fs.min() - pad
+    npts = int(np.ceil((mzs_fs.max() + pad - grid_lo) / step)) + 1
+    grid = grid_lo + step * np.arange(npts)
+    profile = np.zeros(npts)
+    half = int(np.ceil(pad / step))
+    centers = np.rint((mzs_fs - grid_lo) / step).astype(np.int64)
+    for c, mz, ab in zip(centers, mzs_fs, abunds_fs):
+        s = max(0, c - half)
+        e = min(npts, c + half + 1)
+        profile[s:e] += ab * np.exp(-0.5 * ((grid[s:e] - mz) / isocalc_sigma) ** 2)
+
+    # local maxima
+    mids = (profile[1:-1] >= profile[:-2]) & (profile[1:-1] > profile[2:])
+    peak_idx = np.nonzero(mids)[0] + 1
+    if peak_idx.size == 0:
+        peak_idx = np.array([int(np.argmax(profile))])
+
+    # parabolic interpolation around each maximum for sub-grid m/z + height
+    y0, y1, y2 = profile[peak_idx - 1], profile[peak_idx], profile[peak_idx + 1]
+    denom = y0 - 2 * y1 + y2
+    delta = np.where(np.abs(denom) > 0, 0.5 * (y0 - y2) / np.where(denom == 0, 1, denom), 0.0)
+    delta = np.clip(delta, -0.5, 0.5)
+    peak_mzs = grid[peak_idx] + delta * step
+    peak_ints = y1 - 0.25 * (y0 - y2) * delta
+
+    # top n_peaks by intensity, then m/z-ascending; normalize max -> 100
+    if peak_mzs.size > n_peaks:
+        top = np.argsort(peak_ints)[-n_peaks:]
+        top.sort()
+        peak_mzs, peak_ints = peak_mzs[top], peak_ints[top]
+    order = np.argsort(peak_mzs)
+    peak_mzs, peak_ints = peak_mzs[order], peak_ints[order]
+    peak_ints = 100.0 * peak_ints / peak_ints.max()
+    return peak_mzs, peak_ints.astype(np.float64)
+
+
+@dataclass
+class IsotopePatternTable:
+    """Device-friendly packed isotope patterns for a list of ions.
+
+    The TPU-native replacement for the reference's ``theor_peaks`` table +
+    Spark broadcast (``Formulas.get_sf_peak_*`` [U], SURVEY.md #8): fixed-shape
+    (n_ions, max_peaks) arrays, zero-padded, ready to ship to device HBM and
+    shard/replicate over the mesh.
+    """
+
+    sfs: list[str]            # sum formula per ion
+    adducts: list[str]        # adduct per ion
+    mzs: np.ndarray           # (n_ions, max_peaks) f64, 0-padded
+    ints: np.ndarray          # (n_ions, max_peaks) f64, 0-padded, max=100 per row
+    n_valid: np.ndarray       # (n_ions,) i32 — valid peak count per ion
+    targets: np.ndarray       # (n_ions,) bool — target (vs decoy) ion
+
+    @property
+    def n_ions(self) -> int:
+        return self.mzs.shape[0]
+
+    @property
+    def max_peaks(self) -> int:
+        return self.mzs.shape[1]
+
+
+class IsocalcWrapper:
+    """Same responsibility & knobs as the reference class of the same name [U].
+
+    ``cache_dir`` (optional) persists computed patterns per parameter-set, the
+    analog of the cross-job ``theor_peaks`` cache: only (formula, adduct)
+    pairs missing from the cache are recomputed.
+    """
+
+    def __init__(self, cfg: IsotopeGenerationConfig, cache_dir: str | Path | None = None):
+        self.cfg = cfg
+        self.cache_dir = Path(cache_dir) if cache_dir else None
+        self._cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        if self.cache_dir is not None:
+            self.cache_dir.mkdir(parents=True, exist_ok=True)
+            self._cache_path = self.cache_dir / f"theor_peaks_{self._param_key()}.npz"
+            if self._cache_path.exists():
+                with np.load(self._cache_path, allow_pickle=False) as z:
+                    for k in z.files:
+                        if k.endswith("/mzs"):
+                            ion = k[: -len("/mzs")]
+                            self._cache[ion] = (z[k], z[ion + "/ints"])
+
+    def _param_key(self) -> str:
+        c = self.cfg
+        blob = json.dumps(
+            [c.charge, c.isocalc_sigma, c.isocalc_pts_per_mz, c.n_peaks], sort_keys=True
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+    def save_cache(self) -> None:
+        if self.cache_dir is None or not self._cache:
+            return
+        arrays: dict[str, np.ndarray] = {}
+        for ion, (mzs, ints) in self._cache.items():
+            arrays[ion + "/mzs"] = mzs
+            arrays[ion + "/ints"] = ints
+        tmp = self._cache_path.with_suffix(".tmp.npz")
+        np.savez(tmp, **arrays)
+        tmp.replace(self._cache_path)
+
+    def isotope_peaks(self, sf: str, adduct: str) -> tuple[np.ndarray, np.ndarray] | None:
+        """Centroided (mzs, ints) for formula+adduct, or None if the chemistry
+        is invalid (e.g. '-H' from an H-free formula) — the reference skips
+        such ions the same way [U]."""
+        ion = f"{sf}{adduct}"
+        hit = self._cache.get(ion)
+        if hit is not None:
+            return hit
+        try:
+            counts = apply_adduct(parse_formula(sf), adduct)
+        except FormulaError:
+            return None
+        mzs, ints = centroids(
+            counts,
+            self.cfg.charge,
+            self.cfg.isocalc_sigma,
+            self.cfg.isocalc_pts_per_mz,
+            self.cfg.n_peaks,
+        )
+        self._cache[ion] = (mzs, ints)
+        return mzs, ints
+
+    def pattern_table(
+        self,
+        sf_adduct_pairs: list[tuple[str, str]],
+        target_flags: list[bool] | None = None,
+    ) -> IsotopePatternTable:
+        """Compute/load patterns for all pairs and pack them into fixed-shape
+        arrays (invalid-chemistry ions are dropped, like the reference)."""
+        max_peaks = self.cfg.n_peaks
+        kept_sfs: list[str] = []
+        kept_adducts: list[str] = []
+        kept_targets: list[bool] = []
+        rows_mz: list[np.ndarray] = []
+        rows_int: list[np.ndarray] = []
+        n_valid: list[int] = []
+        flags = target_flags if target_flags is not None else [True] * len(sf_adduct_pairs)
+        for (sf, adduct), is_target in zip(sf_adduct_pairs, flags):
+            peaks = self.isotope_peaks(sf, adduct)
+            if peaks is None:
+                continue
+            mzs, ints = peaks
+            k = min(mzs.size, max_peaks)
+            mz_row = np.zeros(max_peaks)
+            int_row = np.zeros(max_peaks)
+            mz_row[:k] = mzs[:k]
+            int_row[:k] = ints[:k]
+            kept_sfs.append(sf)
+            kept_adducts.append(adduct)
+            kept_targets.append(is_target)
+            rows_mz.append(mz_row)
+            rows_int.append(int_row)
+            n_valid.append(k)
+        self.save_cache()
+        return IsotopePatternTable(
+            sfs=kept_sfs,
+            adducts=kept_adducts,
+            mzs=np.array(rows_mz).reshape(len(rows_mz), max_peaks),
+            ints=np.array(rows_int).reshape(len(rows_int), max_peaks),
+            n_valid=np.array(n_valid, dtype=np.int32),
+            targets=np.array(kept_targets, dtype=bool),
+        )
