@@ -1,0 +1,153 @@
+"""Dataset: ragged spectra -> device-friendly spectral-cube layouts.
+
+Reference: ``sm/engine/dataset.py::Dataset`` [U] (SURVEY.md #5) reads the
+converted text dump into an ``RDD[(sp_id, mzs, ints)]``, maps scattered (x,y)
+scan coordinates to a dense row-major pixel index (``_define_pixels_order``),
+and exposes the sample-area mask.  Here the same responsibilities are
+TPU-first: spectra land in a flat CSR layout over the *dense* pixel grid
+(empty pixels = empty rows), sorted by m/z within each pixel, plus a
+prefix-sum array — so ion-image extraction becomes two vmapped
+``searchsorted`` calls and a cumulative-sum difference per (pixel, window)
+with fully static shapes (see ops/imager_jax.py).  The pixel axis is the
+sharding axis: ``NamedSharding(mesh, P("pixels"))`` over the padded cube.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from .imzml import ImzMLReader
+
+
+@dataclass
+class SpectralDataset:
+    """Host-side dataset in flat-CSR-over-dense-pixel-grid layout."""
+
+    nrows: int
+    ncols: int
+    pixel_inds: np.ndarray    # (n_spectra,) i64 — dense row-major pixel index per spectrum
+    mask: np.ndarray          # (nrows, ncols) bool — sample-area mask (pixels with spectra)
+    mzs_flat: np.ndarray      # (P,) f64 — all peaks, grouped by pixel, m/z-sorted per pixel
+    ints_flat: np.ndarray     # (P,) f32
+    row_ptr: np.ndarray       # (n_pixels+1,) i64 — CSR offsets over dense pixel grid
+
+    @property
+    def n_pixels(self) -> int:
+        return self.nrows * self.ncols
+
+    @property
+    def n_spectra(self) -> int:
+        return int(self.pixel_inds.size)
+
+    @property
+    def n_peaks(self) -> int:
+        return int(self.mzs_flat.size)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def from_arrays(
+        cls,
+        coords: np.ndarray,
+        spectra: list[tuple[np.ndarray, np.ndarray]],
+    ) -> "SpectralDataset":
+        """Build from raw (x, y) scan coords + per-spectrum (mzs, ints).
+
+        Pixel-order normalization mirrors the reference's
+        ``_define_pixels_order`` [U]: coordinates are mapped through their
+        sorted unique values (robust to offsets and uniform step sizes), and
+        the dense pixel index is row-major ``row * ncols + col``.
+        """
+        coords = np.asarray(coords, dtype=np.int64)
+        if coords.ndim != 2 or coords.shape[1] != 2 or coords.shape[0] != len(spectra):
+            raise ValueError("coords must be (n_spectra, 2) matching spectra list")
+        ux = np.unique(coords[:, 0])
+        uy = np.unique(coords[:, 1])
+        ncols, nrows = ux.size, uy.size
+        col = np.searchsorted(ux, coords[:, 0])
+        row = np.searchsorted(uy, coords[:, 1])
+        pixel_inds = row * ncols + col
+        if np.unique(pixel_inds).size != pixel_inds.size:
+            raise ValueError("duplicate scan coordinates map to the same pixel")
+
+        mask = np.zeros(nrows * ncols, dtype=bool)
+        mask[pixel_inds] = True
+
+        counts = np.zeros(nrows * ncols, dtype=np.int64)
+        for pi, (mzs, _) in zip(pixel_inds, spectra):
+            counts[pi] = len(mzs)
+        row_ptr = np.zeros(nrows * ncols + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_ptr[1:])
+
+        total = int(row_ptr[-1])
+        mzs_flat = np.empty(total, dtype=np.float64)
+        ints_flat = np.empty(total, dtype=np.float32)
+        for pi, (mzs, ints) in zip(pixel_inds, spectra):
+            s, e = row_ptr[pi], row_ptr[pi + 1]
+            order = np.argsort(mzs, kind="stable")
+            mzs_flat[s:e] = np.asarray(mzs, dtype=np.float64)[order]
+            ints_flat[s:e] = np.asarray(ints, dtype=np.float32)[order]
+
+        return cls(
+            nrows=nrows,
+            ncols=ncols,
+            pixel_inds=pixel_inds,
+            mask=mask.reshape(nrows, ncols),
+            mzs_flat=mzs_flat,
+            ints_flat=ints_flat,
+            row_ptr=row_ptr,
+        )
+
+    @classmethod
+    def from_imzml(cls, path: str | Path) -> "SpectralDataset":
+        with ImzMLReader(path) as rd:
+            spectra = [rd.read_spectrum(i) for i in range(rd.n_spectra)]
+            return cls.from_arrays(rd.coordinates, spectra)
+
+    # -- device layouts --------------------------------------------------
+
+    def row_lengths(self) -> np.ndarray:
+        return np.diff(self.row_ptr)
+
+    def padded_cube(
+        self, pad_to_multiple: int = 128, pixels_multiple: int = 1
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Dense (n_pixels_padded, L) m/z + intensity cube for the TPU path.
+
+        m/z rows are padded with +inf (so searchsorted puts windows before the
+        padding), intensities with 0.  L is the max spectrum length rounded up
+        to ``pad_to_multiple`` (lane-friendly).  ``pixels_multiple`` pads the
+        pixel axis so it divides the mesh's pixel-shard count.  Returns
+        (mz_cube f64, int_cube f32, lens i32); padded pixels have length 0.
+        """
+        lens = self.row_lengths()
+        L = int(max(1, lens.max()))
+        L = -(-L // pad_to_multiple) * pad_to_multiple
+        npix = self.n_pixels
+        npix_pad = -(-npix // pixels_multiple) * pixels_multiple
+        mz_cube = np.full((npix_pad, L), np.inf, dtype=np.float64)
+        int_cube = np.zeros((npix_pad, L), dtype=np.float32)
+        for p in range(npix):
+            s, e = self.row_ptr[p], self.row_ptr[p + 1]
+            n = e - s
+            if n:
+                mz_cube[p, :n] = self.mzs_flat[s:e]
+                int_cube[p, :n] = self.ints_flat[s:e]
+        out_lens = np.zeros(npix_pad, dtype=np.int32)
+        out_lens[:npix] = lens
+        return mz_cube, int_cube, out_lens
+
+    def norm_img_pixel_inds(self) -> np.ndarray:
+        """Dense pixel index per spectrum (reference:
+        ``Dataset.get_norm_img_pixel_inds`` [U])."""
+        return self.pixel_inds
+
+    def get_dims(self) -> tuple[int, int]:
+        """(nrows, ncols), as the reference's ``Dataset.get_dims`` [U]."""
+        return self.nrows, self.ncols
+
+    def get_sample_area_mask(self) -> np.ndarray:
+        return self.mask
