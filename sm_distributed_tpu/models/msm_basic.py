@@ -1,0 +1,153 @@
+"""MSM basic search — the framework's "model": images -> metrics -> FDR.
+
+Reference: ``sm/engine/msm_basic/msm_basic_search.py::MSMBasicSearch.search``
+[U] (SURVEY.md #12, call stack §3.1): compute_sf_images -> sf_image_metrics ->
+FDR.estimate_fdr.  Here the pipeline streams formula batches through a
+backend's fused score function; the backend is selected by
+``SMConfig.backend`` (numpy_ref | jax_tpu) per the north star.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+from ..io.dataset import SpectralDataset
+from ..ops import metrics_np
+from ..ops.fdr import FDR, DecoyAssignment
+from ..ops.imager_np import extract_ion_images
+from ..ops.isocalc import IsocalcWrapper, IsotopePatternTable
+from ..utils.config import DSConfig, SMConfig
+from ..utils.logger import logger, phase_timer
+
+
+def _slice_table(table: IsotopePatternTable, s: int, e: int) -> IsotopePatternTable:
+    return IsotopePatternTable(
+        sfs=table.sfs[s:e],
+        adducts=table.adducts[s:e],
+        mzs=table.mzs[s:e],
+        ints=table.ints[s:e],
+        n_valid=table.n_valid[s:e],
+        targets=table.targets[s:e],
+    )
+
+
+class NumpyBackend:
+    """The reference-semantics CPU backend (stand-in for the Spark-RDD
+    executor; also the parity oracle for jax_tpu)."""
+
+    name = "numpy_ref"
+
+    def __init__(self, ds: SpectralDataset, ds_config: DSConfig):
+        self.ds = ds
+        self.ds_config = ds_config
+
+    def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
+        """(n_ions, 4) array of (chaos, spatial, spectral, msm)."""
+        img_cfg = self.ds_config.image_generation
+        images = extract_ion_images(self.ds, table, img_cfg.ppm)
+        out = np.zeros((table.n_ions, 4))
+        for i in range(table.n_ions):
+            out[i] = metrics_np.ion_metrics(
+                images[i],
+                table.ints[i],
+                int(table.n_valid[i]),
+                self.ds.nrows,
+                self.ds.ncols,
+                nlevels=img_cfg.nlevels,
+                do_preprocessing=img_cfg.do_preprocessing,
+                q=img_cfg.q,
+            )
+        return out
+
+
+def make_backend(name: str, ds: SpectralDataset, ds_config: DSConfig,
+                 sm_config: SMConfig):
+    if name == "numpy_ref":
+        return NumpyBackend(ds, ds_config)
+    if name == "jax_tpu":
+        from .msm_jax import JaxBackend  # deferred: jax import is heavy
+
+        return JaxBackend(ds, ds_config, sm_config)
+    raise ValueError(f"unknown backend {name!r}")
+
+
+@dataclass
+class SearchResultsBundle:
+    """Everything the orchestrator persists (reference: metrics df + sparse
+    ion images handed to SearchResults.store [U])."""
+
+    annotations: pd.DataFrame      # target ions with fdr/fdr_level
+    all_metrics: pd.DataFrame      # every scored ion incl. decoys
+    timings: dict[str, float] = field(default_factory=dict)
+
+
+class MSMBasicSearch:
+    """End-to-end search over a dataset + formula list (class name kept)."""
+
+    def __init__(
+        self,
+        ds: SpectralDataset,
+        formulas: list[str],
+        ds_config: DSConfig,
+        sm_config: SMConfig | None = None,
+        isocalc_cache_dir: str | None = None,
+    ):
+        self.ds = ds
+        self.formulas = list(dict.fromkeys(formulas))  # dedup, keep order
+        self.ds_config = ds_config
+        self.sm_config = sm_config or SMConfig.get_conf()
+        self.isocalc = IsocalcWrapper(
+            ds_config.isotope_generation, cache_dir=isocalc_cache_dir
+        )
+
+    def search(self) -> SearchResultsBundle:
+        timings: dict[str, float] = {}
+        iso_cfg = self.ds_config.isotope_generation
+        fdr = FDR(
+            decoy_sample_size=self.sm_config.fdr.decoy_sample_size,
+            target_adducts=iso_cfg.adducts,
+            seed=self.sm_config.fdr.seed,
+        )
+        with phase_timer("decoy_selection", timings):
+            assignment: DecoyAssignment = fdr.decoy_adduct_selection(self.formulas)
+            pairs, flags = assignment.all_ion_tuples(self.formulas, iso_cfg.adducts)
+        with phase_timer("isotope_patterns", timings):
+            table = self.isocalc.pattern_table(pairs, flags)
+        logger.info(
+            "scoring %d ions (%d targets, %d decoys) with backend=%s",
+            table.n_ions, int(table.targets.sum()),
+            int((~table.targets).sum()), self.sm_config.backend,
+        )
+        backend = make_backend(
+            self.sm_config.backend, self.ds, self.ds_config, self.sm_config
+        )
+        batch = max(1, self.sm_config.parallel.formula_batch)
+        metrics = np.zeros((table.n_ions, 4))
+        with phase_timer("score", timings):
+            for s in range(0, table.n_ions, batch):
+                e = min(s + batch, table.n_ions)
+                metrics[s:e] = backend.score_batch(_slice_table(table, s, e))
+        with phase_timer("fdr", timings):
+            all_df = pd.DataFrame(
+                {
+                    "sf": table.sfs,
+                    "adduct": table.adducts,
+                    "is_target": table.targets,
+                    "chaos": metrics[:, 0],
+                    "spatial": metrics[:, 1],
+                    "spectral": metrics[:, 2],
+                    "msm": metrics[:, 3],
+                }
+            )
+            annotations = fdr.estimate_fdr(all_df[["sf", "adduct", "msm"]], assignment)
+            annotations = annotations.merge(
+                all_df[["sf", "adduct", "chaos", "spatial", "spectral"]],
+                on=["sf", "adduct"],
+                how="left",
+            )
+        return SearchResultsBundle(
+            annotations=annotations, all_metrics=all_df, timings=timings
+        )

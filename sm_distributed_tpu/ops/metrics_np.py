@@ -1,0 +1,131 @@
+"""MSM metrics, NumPy reference backend.
+
+Reference: ``sm/engine/msm_basic/formula_img_validator.py`` [U] (SURVEY.md
+#11, call stack §3.4) computes, per ion, via ``pyImagingMSpec``:
+
+- ``measure_of_chaos(img, nlevels)`` — spatial informativeness: 1 minus the
+  mean connected-component count of the principal-peak image thresholded at
+  ``nlevels`` levels, normalized by the nonzero-pixel count (Palmer et al.
+  2017, Nature Methods 14:57, "measure of spatial chaos");
+- ``isotope_image_correlation(imgs, weights)`` — intensity-weighted mean
+  Pearson correlation between the principal image and each higher-isotope
+  image;
+- ``isotope_pattern_match(imgs_total_ints, theor_ints)`` — cosine agreement
+  between theoretical and observed total-intensity isotope envelopes.
+
+MSM = chaos * spatial * spectral.  Optional hot-spot removal first: clip each
+image at its q-th percentile of positive values (``do_preprocessing``/``q``).
+
+This module is the parity oracle for the TPU backend (ops/metrics_jax.py):
+the exact threshold grid, connectivity (4-neighbour), and clipping rules here
+are the spec.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import ndimage
+
+# 4-connectivity (cross) — scipy.ndimage.label default, matches the reference.
+_STRUCTURE4 = np.array([[0, 1, 0], [1, 1, 1], [0, 1, 0]], dtype=int)
+
+
+def hotspot_clip(img: np.ndarray, q: float = 99.0) -> np.ndarray:
+    """Hot-spot removal (reference img_gen.do_preprocessing [U]): clip at the
+    q-th percentile of the positive pixels; no-op on empty images."""
+    pos = img[img > 0]
+    if pos.size == 0:
+        return img
+    return np.minimum(img, np.percentile(pos, q))
+
+
+def measure_of_chaos(img: np.ndarray, nlevels: int = 30) -> float:
+    """Spatial chaos of a 2-D image in [0, 1]; 0 for empty images.
+
+    Thresholds: ``nlevels`` levels evenly spaced in (0, max) —
+    ``linspace(0, max, nlevels, endpoint=False)`` (level 0 counts the
+    support's components).  Connectivity: 4-neighbour.
+    """
+    img = np.nan_to_num(np.asarray(img, dtype=np.float64))
+    img = np.where(img > 0, img, 0.0)
+    vmax = img.max()
+    n_notnull = int((img > 0).sum())
+    if vmax <= 0 or n_notnull == 0:
+        return 0.0
+    levels = np.linspace(0.0, vmax, nlevels, endpoint=False)
+    counts = np.empty(nlevels)
+    for i, lev in enumerate(levels):
+        _, n = ndimage.label(img > lev, structure=_STRUCTURE4)
+        counts[i] = n
+    return float(max(0.0, 1.0 - counts.mean() / n_notnull))
+
+
+def isotope_image_correlation(
+    images_flat: np.ndarray, weights: np.ndarray
+) -> float:
+    """Weighted mean Pearson correlation of higher-isotope images vs the
+    principal image.  ``images_flat``: (n_peaks, n_pixels); ``weights``:
+    theoretical intensities of peaks 1..n-1 (reference passes
+    ``theor_ints[1:]`` [U]).  NaN correlations (constant images) count as 0;
+    result clipped to [0, 1]."""
+    images_flat = np.asarray(images_flat, dtype=np.float64)
+    n_peaks = images_flat.shape[0]
+    if n_peaks < 2:
+        return 0.0
+    base = images_flat[0]
+    corrs = np.zeros(n_peaks - 1)
+    bc = base - base.mean()
+    bn = np.sqrt((bc * bc).sum())
+    for k in range(1, n_peaks):
+        x = images_flat[k]
+        xc = x - x.mean()
+        xn = np.sqrt((xc * xc).sum())
+        if bn > 0 and xn > 0:
+            corrs[k - 1] = (bc * xc).sum() / (bn * xn)
+    weights = np.asarray(weights, dtype=np.float64)[: n_peaks - 1]
+    wsum = weights.sum()
+    if wsum <= 0:
+        return 0.0
+    return float(np.clip((corrs * weights).sum() / wsum, 0.0, 1.0))
+
+
+def isotope_pattern_match(
+    image_total_ints: np.ndarray, theor_ints: np.ndarray
+) -> float:
+    """Cosine similarity between observed total-intensity envelope and the
+    theoretical envelope, in [0, 1]; 0 if either is empty."""
+    obs = np.asarray(image_total_ints, dtype=np.float64)
+    theor = np.asarray(theor_ints, dtype=np.float64)
+    on = np.linalg.norm(obs)
+    tn = np.linalg.norm(theor)
+    if on == 0 or tn == 0:
+        return 0.0
+    return float(np.clip(np.dot(obs, theor) / (on * tn), 0.0, 1.0))
+
+
+def ion_metrics(
+    images: np.ndarray,
+    theor_ints: np.ndarray,
+    n_valid: int,
+    nrows: int,
+    ncols: int,
+    nlevels: int = 30,
+    do_preprocessing: bool = False,
+    q: float = 99.0,
+) -> tuple[float, float, float, float]:
+    """(chaos, spatial, spectral, msm) for one ion.
+
+    ``images``: (max_peaks, n_pixels) dense; only the first ``n_valid`` rows
+    are real isotope peaks.  Mirrors the reference's per-ion map function
+    ``get_compute_img_metrics`` [U].
+    """
+    imgs = images[:n_valid].astype(np.float64)
+    if n_valid == 0 or imgs[0].max() <= 0:
+        return 0.0, 0.0, 0.0, 0.0
+    if do_preprocessing:
+        imgs = np.stack([hotspot_clip(im, q) for im in imgs])
+    chaos = measure_of_chaos(imgs[0].reshape(nrows, ncols), nlevels)
+    spatial = isotope_image_correlation(imgs, weights=theor_ints[1:n_valid])
+    spectral = isotope_pattern_match(imgs.sum(axis=1), theor_ints[:n_valid])
+    msm = chaos * spatial * spectral
+    return chaos, spatial, spectral, msm

@@ -1,0 +1,114 @@
+"""FDR-engine unit tests with hand-built score tables (reference analog:
+tests/test_fdr.py [U], SURVEY.md §4)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sm_distributed_tpu.ops.fdr import DECOY_ADDUCTS, FDR, FDR_LEVELS
+
+
+def test_decoy_adducts_list():
+    assert len(DECOY_ADDUCTS) == 78
+    assert "+He" in DECOY_ADDUCTS and "+Ru" in DECOY_ADDUCTS
+    assert len(set(DECOY_ADDUCTS)) == len(DECOY_ADDUCTS)
+    # all parse against the isotope table
+    from sm_distributed_tpu.ops.formula import parse_adduct
+    for a in DECOY_ADDUCTS:
+        parse_adduct(a)
+
+
+def test_decoy_selection_seeded_and_sized():
+    fdr = FDR(decoy_sample_size=5, target_adducts=("+H",), seed=123)
+    a1 = fdr.decoy_adduct_selection(["C6H12O6", "C5H5N5"])
+    a2 = FDR(decoy_sample_size=5, target_adducts=("+H",), seed=123
+             ).decoy_adduct_selection(["C6H12O6", "C5H5N5"])
+    assert a1.sample == a2.sample  # deterministic
+    for decoys in a1.sample.values():
+        assert len(decoys) == 5
+        assert len(set(decoys)) == 5          # without replacement
+        assert "+H" not in decoys             # targets excluded
+    a3 = FDR(decoy_sample_size=5, target_adducts=("+H",), seed=124
+             ).decoy_adduct_selection(["C6H12O6"])
+    assert a3.sample != {k: v for k, v in a1.sample.items() if k[0] == "C6H12O6"}
+
+
+def test_qvalues_perfect_separation():
+    # all targets above all decoys -> q = 0 everywhere
+    q = FDR._qvalues(np.array([0.9, 0.8, 0.7]), np.array([0.1, 0.2] * 3), 2)
+    np.testing.assert_allclose(q, 0.0)
+
+
+def test_qvalues_interleaved():
+    # targets 0.9 0.5, decoys 0.7 0.7 (sample size 2, one formula->2 decoys each)
+    q = FDR._qvalues(np.array([0.9, 0.5]), np.array([0.7, 0.7]), 2)
+    # at t=0.9: 0 decoys above -> fdr 0; at t=0.5: 2 decoys/(2*2 targets)=0.5
+    np.testing.assert_allclose(q, [0.0, 0.5])
+
+
+def test_qvalues_monotonic():
+    rng = np.random.default_rng(0)
+    t = rng.random(50)
+    d = rng.random(200) * 0.8
+    q = FDR._qvalues(t, d, 4)
+    order = np.argsort(-t)
+    assert np.all(np.diff(q[order]) >= -1e-12)  # nondecreasing down the ranking
+
+
+def test_qvalues_tie_counts_decoy_first():
+    q = FDR._qvalues(np.array([0.5]), np.array([0.5]), 1)
+    # tie: decoy counted above the target -> fdr = 1/1 = 1
+    np.testing.assert_allclose(q, [1.0])
+
+
+def test_estimate_fdr_end_to_end():
+    fdr = FDR(decoy_sample_size=2, target_adducts=("+H",), seed=0)
+    sfs = [f"C{i}H{2*i}O" for i in range(2, 12)]
+    assignment = fdr.decoy_adduct_selection(sfs)
+    rows = []
+    # strong targets: msm ~0.9; weak targets ~0.1; decoys ~0.3
+    for i, sf in enumerate(sfs):
+        rows.append((sf, "+H", 0.9 if i < 5 else 0.1))
+        for da in assignment.sample[(sf, "+H")]:
+            rows.append((sf, da, 0.3))
+    df = pd.DataFrame(rows, columns=["sf", "adduct", "msm"]).drop_duplicates(
+        subset=["sf", "adduct"]
+    )
+    out = fdr.estimate_fdr(df, assignment)
+    assert set(out.columns) == {"sf", "adduct", "msm", "fdr", "fdr_level"}
+    strong = out[out.msm > 0.5]
+    weak = out[out.msm < 0.5]
+    assert (strong.fdr == 0.0).all()
+    assert (strong.fdr_level == FDR_LEVELS[0]).all()
+    assert (weak.fdr > 0.5).all()       # decoys above them -> high FDR
+    assert (weak.fdr_level == 1.0).all()
+    # ranking is by msm desc within adduct
+    assert list(out.msm) == sorted(out.msm, reverse=True)
+
+
+def test_estimate_fdr_multiple_adducts_independent():
+    fdr = FDR(decoy_sample_size=1, target_adducts=("+H", "+Na"), seed=1)
+    sfs = ["C6H12O6", "C5H5N5"]
+    assignment = fdr.decoy_adduct_selection(sfs)
+    rows = {}
+    for sf in sfs:
+        rows[(sf, "+H")] = 0.9
+        rows[(sf, "+Na")] = 0.05
+        for ta in ("+H", "+Na"):
+            for da in assignment.sample[(sf, ta)]:
+                rows.setdefault((sf, da), 0.5)
+    df = pd.DataFrame(
+        [(sf, a, m) for (sf, a), m in rows.items()], columns=["sf", "adduct", "msm"]
+    )
+    out = fdr.estimate_fdr(df, assignment)
+    h = out[out.adduct == "+H"]
+    na = out[out.adduct == "+Na"]
+    assert (h.fdr == 0.0).all()          # +H targets above their decoys
+    assert (na.fdr > 0.0).all()          # +Na targets below their decoys
+
+
+def test_bad_params():
+    with pytest.raises(ValueError):
+        FDR(decoy_sample_size=0)
+    with pytest.raises(ValueError):
+        FDR(decoy_sample_size=1000)

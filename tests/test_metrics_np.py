@@ -1,0 +1,109 @@
+"""MSM-metric unit tests (reference analog: tests/test_formula_img_validator.py
+[U], SURVEY.md §4) — hand-built images with known component counts."""
+
+import numpy as np
+import pytest
+
+from sm_distributed_tpu.ops.metrics_np import (
+    hotspot_clip,
+    ion_metrics,
+    isotope_image_correlation,
+    isotope_pattern_match,
+    measure_of_chaos,
+)
+
+
+def test_chaos_empty_image():
+    assert measure_of_chaos(np.zeros((8, 8))) == 0.0
+
+
+def test_chaos_single_blob_high():
+    img = np.zeros((16, 16))
+    img[4:12, 4:12] = 1.0
+    # one component at every level, 64 nonzero pixels: 1 - 1/64
+    assert measure_of_chaos(img, nlevels=30) == pytest.approx(1 - 1 / 64)
+
+
+def test_chaos_scattered_noise_low():
+    rng = np.random.default_rng(0)
+    img = np.zeros((16, 16))
+    # 40 isolated single pixels in a diagonal-ish scatter (no 4-adjacency)
+    cells = [(r, c) for r in range(16) for c in range(16) if (r + c) % 2 == 0]
+    idx = rng.choice(len(cells), size=40, replace=False)
+    for i in idx:
+        r, c = cells[i]
+        img[r, c] = rng.uniform(0.5, 1.0)
+    chaos = measure_of_chaos(img, nlevels=30)
+    # ~40 components / 40 pixels at low levels -> chaos near 0
+    assert chaos < 0.35
+
+
+def test_chaos_structured_beats_noise():
+    yy, xx = np.mgrid[0:32, 0:32]
+    blob = np.exp(-((yy - 16) ** 2 + (xx - 16) ** 2) / 50.0)
+    blob[blob < 0.05] = 0
+    rng = np.random.default_rng(1)
+    noise = (rng.random((32, 32)) < 0.15) * rng.random((32, 32))
+    assert measure_of_chaos(blob) > 0.9 > measure_of_chaos(noise)
+
+
+def test_chaos_4_vs_8_connectivity():
+    # two diagonal pixels: 4-connectivity sees TWO components
+    img = np.zeros((4, 4))
+    img[1, 1] = img[2, 2] = 1.0
+    assert measure_of_chaos(img, nlevels=10) == pytest.approx(1 - 2 / 2)  # = 0
+
+
+def test_image_correlation_perfect_and_anti():
+    base = np.arange(16.0)
+    imgs = np.stack([base, base * 2.0, base[::-1]])
+    # weights: peak1 strongly, peak2 weakly
+    corr = isotope_image_correlation(imgs, weights=np.array([100.0, 0.0]))
+    assert corr == pytest.approx(1.0)
+    corr2 = isotope_image_correlation(imgs, weights=np.array([0.0, 100.0]))
+    assert corr2 == 0.0  # anti-correlation clipped to 0
+
+
+def test_image_correlation_constant_image_counts_zero():
+    base = np.arange(16.0)
+    imgs = np.stack([base, np.full(16, 3.0)])
+    assert isotope_image_correlation(imgs, weights=np.array([50.0])) == 0.0
+
+
+def test_pattern_match():
+    theor = np.array([100.0, 10.0, 1.0])
+    assert isotope_pattern_match(theor * 7.3, theor) == pytest.approx(1.0)
+    assert isotope_pattern_match(np.zeros(3), theor) == 0.0
+    # orthogonal envelope
+    assert isotope_pattern_match(np.array([0.0, 0.0, 5.0]), np.array([1.0, 0, 0])) == 0.0
+
+
+def test_hotspot_clip():
+    img = np.ones(100)
+    img[0] = 1000.0
+    clipped = hotspot_clip(img, q=95)
+    assert clipped.max() < 1000.0
+    assert clipped[1:].max() == 1.0
+    # empty image untouched
+    np.testing.assert_array_equal(hotspot_clip(np.zeros(4)), np.zeros(4))
+
+
+def test_ion_metrics_product():
+    nrows = ncols = 8
+    yy, xx = np.mgrid[0:nrows, 0:ncols]
+    blob = np.exp(-((yy - 4) ** 2 + (xx - 4) ** 2) / 6.0).ravel()
+    theor = np.array([100.0, 8.0, 1.0, 0.0])
+    images = np.stack([blob * t / 100.0 for t in theor])
+    chaos, spatial, spectral, msm = ion_metrics(
+        images, theor, n_valid=3, nrows=nrows, ncols=ncols
+    )
+    assert msm == pytest.approx(chaos * spatial * spectral)
+    assert spatial == pytest.approx(1.0)
+    assert spectral == pytest.approx(1.0)
+    assert 0.8 < chaos <= 1.0
+
+
+def test_ion_metrics_empty_principal():
+    images = np.zeros((4, 64))
+    out = ion_metrics(images, np.array([100.0, 10, 1, 0]), 3, 8, 8)
+    assert out == (0.0, 0.0, 0.0, 0.0)

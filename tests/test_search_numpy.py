@@ -1,0 +1,132 @@
+"""Imager unit tests + end-to-end numpy_ref search on the synthetic fixture
+(reference analogs: tests/test_formula_imager_segm.py and
+test_search_job_imzml_example.py [U], SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from sm_distributed_tpu.io.dataset import SpectralDataset
+from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+from sm_distributed_tpu.models.msm_basic import MSMBasicSearch
+from sm_distributed_tpu.ops.imager_np import extract_ion_images
+from sm_distributed_tpu.ops.isocalc import IsotopePatternTable
+from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+
+def _tiny_table(mzs, n_valid=None, targets=None):
+    mzs = np.asarray(mzs, dtype=np.float64)
+    n, k = mzs.shape
+    return IsotopePatternTable(
+        sfs=[f"SF{i}" for i in range(n)],
+        adducts=["+H"] * n,
+        mzs=mzs,
+        ints=np.where(mzs > 0, 100.0, 0.0),
+        n_valid=np.asarray(n_valid if n_valid is not None else [k] * n, dtype=np.int32),
+        targets=np.asarray(targets if targets is not None else [True] * n, dtype=bool),
+    )
+
+
+def test_extract_exact_window_semantics():
+    # 2x2 grid; peaks at known m/z in specific pixels
+    coords = np.array([[1, 1], [2, 1], [1, 2], [2, 2]])
+    spectra = [
+        (np.array([100.0000, 200.0]), np.array([1.0, 5.0])),
+        (np.array([100.0001]), np.array([2.0])),       # +1 ppm of 100
+        (np.array([100.0010]), np.array([3.0])),       # +10 ppm -> outside 3ppm window
+        (np.array([], dtype=float), np.array([], dtype=float)),
+    ]
+    ds = SpectralDataset.from_arrays(coords, spectra)
+    table = _tiny_table([[100.0, 200.0]])
+    images = extract_ion_images(ds, table, ppm=3.0)
+    assert images.shape == (1, 2, 4)
+    np.testing.assert_allclose(images[0, 0], [1.0, 2.0, 0.0, 0.0])  # pixel 2 excluded
+    np.testing.assert_allclose(images[0, 1], [5.0, 0.0, 0.0, 0.0])
+
+
+def test_extract_sums_multiple_hits_per_pixel():
+    coords = np.array([[1, 1]])
+    spectra = [(np.array([99.99995, 100.0, 100.00005]), np.array([1.0, 2.0, 4.0]))]
+    ds = SpectralDataset.from_arrays(coords, spectra)
+    table = _tiny_table([[100.0]])
+    images = extract_ion_images(ds, table, ppm=1.0)
+    assert images[0, 0, 0] == pytest.approx(7.0)  # all three within 1 ppm
+
+
+def test_extract_invalid_peaks_zero():
+    coords = np.array([[1, 1]])
+    spectra = [(np.array([100.0, 200.0]), np.array([1.0, 1.0]))]
+    ds = SpectralDataset.from_arrays(coords, spectra)
+    table = _tiny_table([[100.0, 200.0]], n_valid=[1])
+    images = extract_ion_images(ds, table, ppm=3.0)
+    assert images[0, 0, 0] == 1.0
+    np.testing.assert_array_equal(images[0, 1], 0.0)  # padded peak: no image
+
+
+def test_extract_overlapping_windows_both_hit():
+    # two ions with nearly identical m/z: both must see the data peak
+    coords = np.array([[1, 1]])
+    spectra = [(np.array([100.0]), np.array([3.0]))]
+    ds = SpectralDataset.from_arrays(coords, spectra)
+    table = _tiny_table([[100.00001], [99.99999]])
+    images = extract_ion_images(ds, table, ppm=3.0)
+    assert images[0, 0, 0] == 3.0
+    assert images[1, 0, 0] == 3.0
+
+
+@pytest.fixture(scope="module")
+def synthetic_ds(tmp_path_factory):
+    out = tmp_path_factory.mktemp("ds")
+    path, truth = generate_synthetic_dataset(
+        out, nrows=16, ncols=16, formulas=None, present_fraction=0.5,
+        noise_peaks=80, seed=11,
+    )
+    return SpectralDataset.from_imzml(path), truth
+
+
+def test_numpy_ref_search_end_to_end(synthetic_ds):
+    ds, truth = synthetic_ds
+    sm_config = SMConfig.from_dict(
+        {"backend": "numpy_ref", "fdr": {"decoy_sample_size": 8, "seed": 3},
+         "parallel": {"formula_batch": 64}}
+    )
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}}
+    )
+    job = MSMBasicSearch(ds, truth.formulas, ds_config, sm_config)
+    bundle = job.search()
+    ann = bundle.annotations
+
+    assert set(ann.adduct) == {"+H"}
+    assert len(ann) == len(truth.formulas)
+    present = ann[ann.sf.isin(truth.present)]
+    absent = ann[~ann.sf.isin(truth.present)]
+
+    # every present formula got real signal scored
+    assert (present.msm > 0.2).all(), present[["sf", "msm"]]
+    # FDR separates present from absent cleanly on this fixture
+    accepted = ann[ann.fdr_level <= 0.1]
+    acc_set = set(accepted.sf)
+    missing = set(truth.present) - acc_set
+    false_pos = acc_set - set(truth.present)
+    assert len(missing) <= max(1, len(truth.present) // 10), f"missed: {missing}"
+    assert len(false_pos) <= max(1, len(truth.present) // 10), f"false: {false_pos}"
+    # absent formulas score below present ones on average
+    assert present.msm.mean() > 3 * max(absent.msm.mean(), 0.01)
+    # decoys were actually scored
+    decoys = bundle.all_metrics[~bundle.all_metrics.is_target]
+    assert len(decoys) > 0
+
+
+def test_search_deterministic(synthetic_ds):
+    ds, truth = synthetic_ds
+    sm_config = SMConfig.from_dict(
+        {"backend": "numpy_ref", "fdr": {"decoy_sample_size": 4, "seed": 5},
+         "parallel": {"formula_batch": 32}}
+    )
+    ds_config = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    sub = truth.formulas[:10]
+    r1 = MSMBasicSearch(ds, sub, ds_config, sm_config).search().annotations
+    r2 = MSMBasicSearch(ds, sub, ds_config, sm_config).search().annotations
+    pd_testing = pytest.importorskip("pandas.testing")
+    pd_testing.assert_frame_equal(r1, r2)
