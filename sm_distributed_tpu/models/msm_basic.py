@@ -17,7 +17,7 @@ import pandas as pd
 from ..io.dataset import SpectralDataset
 from ..ops import metrics_np
 from ..ops.fdr import FDR, DecoyAssignment
-from ..ops.imager_np import extract_ion_images
+from ..ops.imager_np import SortedPeakView, extract_ion_images
 from ..ops.isocalc import IsocalcWrapper, IsotopePatternTable
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger, phase_timer
@@ -43,11 +43,12 @@ class NumpyBackend:
     def __init__(self, ds: SpectralDataset, ds_config: DSConfig):
         self.ds = ds
         self.ds_config = ds_config
+        self._view = SortedPeakView.prepare(ds)  # sort once, reuse per batch
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         """(n_ions, 4) array of (chaos, spatial, spectral, msm)."""
         img_cfg = self.ds_config.image_generation
-        images = extract_ion_images(self.ds, table, img_cfg.ppm)
+        images = extract_ion_images(self._view, table, img_cfg.ppm)
         out = np.zeros((table.n_ions, 4))
         for i in range(table.n_ions):
             out[i] = metrics_np.ion_metrics(
@@ -103,8 +104,18 @@ class MSMBasicSearch:
             ds_config.isotope_generation, cache_dir=isocalc_cache_dir
         )
 
+    _ANN_COLUMNS = ["sf", "adduct", "msm", "fdr", "fdr_level",
+                    "chaos", "spatial", "spectral"]
+    _ALL_COLUMNS = ["sf", "adduct", "is_target", "chaos", "spatial",
+                    "spectral", "msm"]
+
     def search(self) -> SearchResultsBundle:
         timings: dict[str, float] = {}
+        if not self.formulas:
+            return SearchResultsBundle(
+                annotations=pd.DataFrame(columns=self._ANN_COLUMNS),
+                all_metrics=pd.DataFrame(columns=self._ALL_COLUMNS),
+            )
         iso_cfg = self.ds_config.isotope_generation
         fdr = FDR(
             decoy_sample_size=self.sm_config.fdr.decoy_sample_size,
@@ -148,6 +159,9 @@ class MSMBasicSearch:
                 on=["sf", "adduct"],
                 how="left",
             )
+            # keep the declared schema authoritative for empty & non-empty paths
+            annotations = annotations[self._ANN_COLUMNS]
+            all_df = all_df[self._ALL_COLUMNS]
         return SearchResultsBundle(
             annotations=annotations, all_metrics=all_df, timings=timings
         )

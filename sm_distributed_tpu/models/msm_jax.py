@@ -1,0 +1,100 @@
+"""JAX/TPU backend: the fused extract+score graph.
+
+North star (BASELINE.json): ion-image extraction and MSM scoring become JAX
+functions vmapped over formula batches, the spectral cube a device-resident
+(pixels x m/z) array, theoretical patterns a device tensor, and target/decoy
+scoring one fused XLA graph.  This module is that graph, single-device; the
+mesh-sharded variant lives in parallel/ (SURVEY.md §5.8).
+
+The graph compiles ONCE per dataset: formula batches are padded to the static
+``formula_batch`` size, so every batch reuses the same executable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.dataset import SpectralDataset
+from ..ops.imager_jax import cumulative_intensities, extract_images, prepare_cube_arrays
+from ..ops.isocalc import IsotopePatternTable
+from ..ops.metrics_jax import batch_metrics
+from ..ops.quantize import quantize_window
+from ..utils.config import DSConfig, SMConfig
+from ..utils.logger import logger
+
+
+def fused_score_fn(
+    mz_q_cube: jnp.ndarray,    # (P_pad, L) int32
+    cum_int: jnp.ndarray,      # (P_pad, L+1) f32
+    lo_q: jnp.ndarray,         # (B, K) int32
+    hi_q: jnp.ndarray,         # (B, K) int32
+    theor_ints: jnp.ndarray,   # (B, K) f32
+    n_valid: jnp.ndarray,      # (B,) i32
+    *,
+    nrows: int,
+    ncols: int,
+    nlevels: int,
+    do_preprocessing: bool,
+    q: float,
+) -> jnp.ndarray:
+    """images -> metrics for one formula batch: (B, 4). One XLA graph."""
+    b, k = lo_q.shape
+    imgs = extract_images(mz_q_cube, cum_int, lo_q.ravel(), hi_q.ravel())
+    imgs = imgs.reshape(b, k, -1)[:, :, : nrows * ncols]   # drop padded pixels
+    return batch_metrics(
+        imgs, theor_ints, n_valid, nrows, ncols, nlevels,
+        do_preprocessing=do_preprocessing, q=q,
+    )
+
+
+class JaxBackend:
+    """Fused-graph scorer selected by ``SMConfig.backend == 'jax_tpu'``."""
+
+    name = "jax_tpu"
+
+    def __init__(self, ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConfig):
+        self.ds = ds
+        self.ds_config = ds_config
+        self.batch = max(1, sm_config.parallel.formula_batch)
+        img_cfg = ds_config.image_generation
+        self.ppm = img_cfg.ppm
+
+        mz_q, int_cube = prepare_cube_arrays(ds)
+        self._mz_q = jax.device_put(mz_q)
+        self._cum = cumulative_intensities(jax.device_put(int_cube))
+        logger.info(
+            "jax_tpu cube resident: %s int32 + %s f32 on %s",
+            mz_q.shape, int_cube.shape, self._mz_q.devices(),
+        )
+        self._fn = jax.jit(
+            partial(
+                fused_score_fn,
+                nrows=ds.nrows,
+                ncols=ds.ncols,
+                nlevels=img_cfg.nlevels,
+                do_preprocessing=img_cfg.do_preprocessing,
+                q=img_cfg.q,
+            )
+        )
+
+    def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
+        n = table.n_ions
+        b = self.batch
+        if n > b:
+            raise ValueError(f"batch of {n} ions exceeds formula_batch={b}")
+        k = table.max_peaks
+        lo_q, hi_q = quantize_window(table.mzs, self.ppm)
+        # pad to the static batch size (padded ions: n_valid=0 -> all metrics 0)
+        lo_p = np.zeros((b, k), dtype=np.int32)
+        hi_p = np.zeros((b, k), dtype=np.int32)
+        ints_p = np.zeros((b, k), dtype=np.float32)
+        nv_p = np.zeros(b, dtype=np.int32)
+        lo_p[:n], hi_p[:n] = lo_q, hi_q
+        ints_p[:n] = table.ints
+        nv_p[:n] = table.n_valid
+        out = self._fn(self._mz_q, self._cum, lo_p, hi_p, ints_p, nv_p)
+        return np.asarray(out)[:n].astype(np.float64)

@@ -16,44 +16,59 @@ from __future__ import annotations
 
 import numpy as np
 
+from dataclasses import dataclass
+
 from ..io.dataset import SpectralDataset
 from .isocalc import IsotopePatternTable
+from .quantize import quantize_mz, quantize_window
 
 
-def peak_bounds(mzs: np.ndarray, ppm: float) -> tuple[np.ndarray, np.ndarray]:
-    """Lower/upper m/z window bounds (reference: Formulas.get_sf_peak_bounds [U]).
-    Zero-padded (invalid) peaks produce empty windows."""
-    lo = mzs * (1.0 - ppm * 1e-6)
-    hi = mzs * (1.0 + ppm * 1e-6)
-    return lo, hi
+@dataclass
+class SortedPeakView:
+    """Once-per-dataset prep: all dataset peaks globally m/z-sorted on the
+    quantized grid (the reference's per-segment sort, unsegmented).  Built
+    once and reused across formula batches."""
+
+    n_pixels: int
+    g_mzs_q: np.ndarray        # (P,) int32, ascending
+    g_ints: np.ndarray         # (P,) f32
+    pixel_of_peak: np.ndarray  # (P,) i64 — dense pixel index per sorted peak
+
+    @classmethod
+    def prepare(cls, ds: SpectralDataset) -> "SortedPeakView":
+        g_mzs_q_unsorted = quantize_mz(ds.mzs_flat)
+        order = np.argsort(g_mzs_q_unsorted, kind="stable")
+        pixel_of_peak = np.repeat(
+            np.arange(ds.n_pixels, dtype=np.int64), ds.row_lengths()
+        )[order]
+        return cls(
+            n_pixels=ds.n_pixels,
+            g_mzs_q=g_mzs_q_unsorted[order],
+            g_ints=ds.ints_flat[order],
+            pixel_of_peak=pixel_of_peak,
+        )
 
 
 def extract_ion_images(
-    ds: SpectralDataset,
+    source: SpectralDataset | SortedPeakView,
     table: IsotopePatternTable,
     ppm: float,
 ) -> np.ndarray:
     """Dense ion images: (n_ions, max_peaks, n_pixels) float32.
 
-    Padded (invalid) isotope peaks yield all-zero images, like the reference's
-    missing sparse matrices.
+    Matching happens on the shared quantized m/z grid (ops/quantize.py) so the
+    hit set is exactly the jax_tpu backend's.  Padded (invalid) isotope peaks
+    yield all-zero images, like the reference's missing sparse matrices.
+    Pass a prebuilt SortedPeakView when scoring many batches.
     """
-    # global m/z sort of all dataset peaks (the CSR layout is per-pixel sorted;
-    # re-sorting globally once is the reference's per-segment sort, unsegmented)
-    order = np.argsort(ds.mzs_flat, kind="stable")
-    g_mzs = ds.mzs_flat[order]
-    g_ints = ds.ints_flat[order]
-    # recover each peak's dense pixel index from the CSR row pointers
-    pixel_of_peak = np.repeat(
-        np.arange(ds.n_pixels, dtype=np.int64), ds.row_lengths()
-    )[order]
+    view = source if isinstance(source, SortedPeakView) else SortedPeakView.prepare(source)
 
-    lo, hi = peak_bounds(table.mzs, ppm)
-    start = np.searchsorted(g_mzs, lo.ravel(), side="left").reshape(lo.shape)
-    end = np.searchsorted(g_mzs, hi.ravel(), side="left").reshape(hi.shape)
+    lo, hi = quantize_window(table.mzs, ppm)
+    start = np.searchsorted(view.g_mzs_q, lo.ravel(), side="left").reshape(lo.shape)
+    end = np.searchsorted(view.g_mzs_q, hi.ravel(), side="left").reshape(hi.shape)
 
     n_ions, max_peaks = table.mzs.shape
-    images = np.zeros((n_ions, max_peaks, ds.n_pixels), dtype=np.float32)
+    images = np.zeros((n_ions, max_peaks, view.n_pixels), dtype=np.float32)
     valid = np.arange(max_peaks)[None, :] < table.n_valid[:, None]
     for i in range(n_ions):
         for k in range(max_peaks):
@@ -62,6 +77,7 @@ def extract_ion_images(
             s, e = start[i, k], end[i, k]
             if e > s:
                 images[i, k] = np.bincount(
-                    pixel_of_peak[s:e], weights=g_ints[s:e], minlength=ds.n_pixels
+                    view.pixel_of_peak[s:e], weights=view.g_ints[s:e],
+                    minlength=view.n_pixels,
                 ).astype(np.float32)
     return images

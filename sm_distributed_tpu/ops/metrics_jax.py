@@ -1,0 +1,172 @@
+"""MSM metrics, JAX/TPU backend.
+
+Device-side counterparts of ops/metrics_np.py (the parity oracle):
+
+- ``measure_of_chaos``: connected components without dynamic shapes — the
+  genuinely hard TPU kernel (SURVEY.md §7 hard part 1).  Implemented as
+  min-label propagation with pointer jumping (the classic parallel
+  connected-components scheme): labels start as pixel indices, each step
+  takes the 4-neighbour minimum and then compresses chains by gathering
+  labels through themselves; a ``lax.while_loop`` runs to the exact fixpoint
+  (component count = #pixels whose final label equals their own index), so
+  counts match scipy.ndimage.label exactly.
+- correlation / pattern match: masked dot products, trivially vmapped.
+
+All functions take a whole formula batch and are designed to live inside one
+fused jit with the extraction kernel (north star: one fused XLA graph).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _cc_count(mask_flat: jnp.ndarray, nrows: int, ncols: int) -> jnp.ndarray:
+    """Exact 4-connectivity component count of a boolean (nrows*ncols,) mask."""
+    n_pix = nrows * ncols
+    iota = jnp.arange(n_pix, dtype=jnp.int32)
+    big = jnp.int32(n_pix)
+    labels0 = jnp.where(mask_flat, iota, big)
+
+    def one_iter(labels):
+        lab = labels.reshape(nrows, ncols)
+        up = jnp.concatenate([jnp.full((1, ncols), big, jnp.int32), lab[:-1]], axis=0)
+        down = jnp.concatenate([lab[1:], jnp.full((1, ncols), big, jnp.int32)], axis=0)
+        left = jnp.concatenate([jnp.full((nrows, 1), big, jnp.int32), lab[:, :-1]], axis=1)
+        right = jnp.concatenate([lab[:, 1:], jnp.full((nrows, 1), big, jnp.int32)], axis=1)
+        nmin = jnp.minimum(jnp.minimum(up, down), jnp.minimum(left, right)).ravel()
+        lab_new = jnp.where(mask_flat, jnp.minimum(labels, nmin), big)
+        # pointer jumping (x2): follow label -> label-of-label to compress chains
+        for _ in range(2):
+            g = lab_new[jnp.clip(lab_new, 0, n_pix - 1)]
+            lab_new = jnp.where(lab_new < big, g, big)
+        return lab_new
+
+    def cond(state):
+        labels, prev = state
+        return jnp.any(labels != prev)
+
+    def body(state):
+        labels, _ = state
+        return one_iter(labels), labels
+
+    labels, _ = lax.while_loop(cond, body, (one_iter(labels0), labels0))
+    return jnp.sum((labels == iota) & mask_flat)
+
+
+def measure_of_chaos_batch(
+    principal: jnp.ndarray,   # (N, n_pix) f32, n_pix == nrows*ncols
+    nrows: int,
+    ncols: int,
+    nlevels: int = 30,
+) -> jnp.ndarray:
+    """(N,) chaos scores; matches metrics_np.measure_of_chaos semantics:
+    thresholds vmax * i/nlevels for i in 0..nlevels-1, 4-connectivity,
+    chaos = max(0, 1 - mean(component counts)/n_nonzero), 0 for empty."""
+    principal = jnp.maximum(principal, 0.0)
+    vmax = principal.max(axis=1)                       # (N,)
+    n_notnull = jnp.sum(principal > 0, axis=1)         # (N,)
+
+    def per_level(_, frac):
+        levels = vmax * frac                            # (N,)
+        masks = principal > levels[:, None]             # (N, n_pix)
+        counts = jax.vmap(partial(_cc_count, nrows=nrows, ncols=ncols))(masks)
+        return _, counts.astype(jnp.float32)
+
+    fracs = jnp.arange(nlevels, dtype=jnp.float32) / nlevels
+    _, counts = lax.scan(per_level, None, fracs)        # (nlevels, N)
+    mean_counts = counts.mean(axis=0)
+    chaos = 1.0 - mean_counts / jnp.maximum(n_notnull, 1)
+    chaos = jnp.clip(chaos, 0.0, 1.0)
+    return jnp.where((vmax > 0) & (n_notnull > 0), chaos, 0.0)
+
+
+def isotope_image_correlation_batch(
+    images: jnp.ndarray,      # (N, K, P) f32
+    weights: jnp.ndarray,     # (N, K) theoretical intensities (weights[:,1:] used)
+    valid: jnp.ndarray,       # (N, K) bool
+) -> jnp.ndarray:
+    """(N,) weighted mean Pearson correlation of peaks 1..K-1 vs peak 0,
+    NaN-free (constant images count 0), clipped to [0,1]."""
+    mean = images.mean(axis=-1, keepdims=True)
+    cent = images - mean
+    norm = jnp.sqrt(jnp.sum(cent * cent, axis=-1))          # (N, K)
+    base = cent[:, 0, :]                                    # (N, P)
+    dots = jnp.einsum("np,nkp->nk", base, cent)             # (N, K)
+    denom = norm[:, 0:1] * norm                             # (N, K)
+    corr = jnp.where(denom > 0, dots / jnp.maximum(denom, 1e-30), 0.0)
+    w = jnp.where(valid, weights, 0.0).at[:, 0].set(0.0)    # exclude principal
+    wsum = w.sum(axis=1)
+    out = jnp.where(wsum > 0, (corr * w).sum(axis=1) / jnp.maximum(wsum, 1e-30), 0.0)
+    return jnp.clip(out, 0.0, 1.0)
+
+
+def isotope_pattern_match_batch(
+    totals: jnp.ndarray,      # (N, K) observed total intensity per isotope image
+    theor: jnp.ndarray,       # (N, K) theoretical intensities
+    valid: jnp.ndarray,       # (N, K) bool
+) -> jnp.ndarray:
+    """(N,) cosine between masked envelopes, in [0,1]."""
+    obs = jnp.where(valid, totals, 0.0)
+    th = jnp.where(valid, theor, 0.0)
+    on = jnp.sqrt(jnp.sum(obs * obs, axis=1))
+    tn = jnp.sqrt(jnp.sum(th * th, axis=1))
+    dot = jnp.sum(obs * th, axis=1)
+    out = jnp.where((on > 0) & (tn > 0), dot / jnp.maximum(on * tn, 1e-30), 0.0)
+    return jnp.clip(out, 0.0, 1.0)
+
+
+def hotspot_clip_batch(images: jnp.ndarray, q: float) -> jnp.ndarray:
+    """Device-side hot-spot removal matching metrics_np.hotspot_clip: clip each
+    (ion, peak) image at the q-th linear-interpolated percentile of its
+    positive pixels; images with no positive pixels pass through.
+
+    ``images``: (..., P).  Masked percentile without dynamic shapes: sort the
+    row ascending (zeros first), the positives occupy the top m slots, and the
+    percentile sits at fractional position (P - m) + (q/100)*(m - 1).
+    """
+    p = images.shape[-1]
+    srt = jnp.sort(images, axis=-1)
+    m = jnp.sum(images > 0, axis=-1)                       # (...,)
+    pos = (p - m) + (q / 100.0) * jnp.maximum(m - 1, 0)
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, p - 1)
+    hi = jnp.clip(lo + 1, 0, p - 1)
+    frac = (pos - lo.astype(pos.dtype))[..., None]
+    v_lo = jnp.take_along_axis(srt, lo[..., None], axis=-1)
+    v_hi = jnp.take_along_axis(srt, hi[..., None], axis=-1)
+    cutoff = v_lo + (v_hi - v_lo) * frac                   # (..., 1)
+    clipped = jnp.minimum(images, cutoff)
+    return jnp.where((m > 0)[..., None], clipped, images)
+
+
+def batch_metrics(
+    images: jnp.ndarray,      # (N, K, n_pix) f32 — n_pix == nrows*ncols exactly
+    theor_ints: jnp.ndarray,  # (N, K) f32
+    n_valid: jnp.ndarray,     # (N,) i32
+    nrows: int,
+    ncols: int,
+    nlevels: int = 30,
+    do_preprocessing: bool = False,
+    q: float = 99.0,
+) -> jnp.ndarray:
+    """(N, 4) of (chaos, spatial, spectral, msm) for a formula batch."""
+    k = images.shape[1]
+    valid = jnp.arange(k)[None, :] < n_valid[:, None]
+    images = jnp.where(valid[:, :, None], images, 0.0)
+    if do_preprocessing:
+        images = hotspot_clip_batch(images, q)
+
+    chaos = measure_of_chaos_batch(images[:, 0, :], nrows, ncols, nlevels)
+    spatial = isotope_image_correlation_batch(images, theor_ints, valid)
+    spectral = isotope_pattern_match_batch(images.sum(axis=-1), theor_ints, valid)
+
+    alive = (n_valid > 0) & (images[:, 0, :].max(axis=1) > 0)
+    chaos = jnp.where(alive, chaos, 0.0)
+    spatial = jnp.where(alive, spatial, 0.0)
+    spectral = jnp.where(alive, spectral, 0.0)
+    msm = chaos * spatial * spectral
+    return jnp.stack([chaos, spatial, spectral, msm], axis=1)

@@ -1,0 +1,40 @@
+"""m/z quantization — the shared grid that makes backends bit-identical.
+
+Both backends quantize m/z values and ppm-window bounds to int32 units of
+1e-5 Da before matching.  Rationale (TPU-first design, SURVEY.md §7):
+
+- TPU has no native f64 (emulated, slow); int32 compares are native.
+- Quantizing *identically* on the host makes the numpy_ref and jax_tpu hit
+  sets exactly equal — window-edge parity is by construction, not tolerance.
+- 1e-5 Da = 0.01 ppm at m/z 1000; windows are ppm-scale, so the quantization
+  error is far below instrument accuracy (the reference matches in f64
+  [U, formula_imager_segm], a difference without scientific consequence).
+
+int32 ceiling: 2**31 * 1e-5 = 21474 Da, far above any MS m/z range.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MZ_SCALE = 1e5  # quantization steps per Da
+MZ_MAX = (2**31 - 2) / MZ_SCALE
+# padding sentinel for m/z cubes: larger than any real quantized m/z
+MZ_PAD_Q = np.int32(2**31 - 1)
+
+
+def quantize_mz(mz: np.ndarray) -> np.ndarray:
+    """Host-side f64 -> int32 grid. Values beyond MZ_MAX (incl. +inf padding)
+    saturate to the padding sentinel."""
+    mz = np.asarray(mz, dtype=np.float64)
+    q = np.rint(mz * MZ_SCALE)
+    return np.where(q >= MZ_PAD_Q, MZ_PAD_Q, q).astype(np.int32)
+
+
+def quantize_window(mzs: np.ndarray, ppm: float) -> tuple[np.ndarray, np.ndarray]:
+    """ppm windows [mz*(1-ppm*1e-6), mz*(1+ppm*1e-6)) on the quantized grid.
+    Computed in f64 on host, identically in both backends."""
+    mzs = np.asarray(mzs, dtype=np.float64)
+    lo = quantize_mz(mzs * (1.0 - ppm * 1e-6))
+    hi = quantize_mz(mzs * (1.0 + ppm * 1e-6))
+    return lo, hi

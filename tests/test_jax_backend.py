@@ -1,0 +1,183 @@
+"""JAX-backend parity tests vs the numpy_ref oracle (SURVEY.md §7 build plan
+item 8: golden-report comparison between backends — identical FDR ranks,
+metric tolerance)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sm_distributed_tpu.io.dataset import SpectralDataset
+from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+from sm_distributed_tpu.models.msm_basic import MSMBasicSearch
+from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+
+@pytest.fixture(scope="module")
+def fixture_ds(tmp_path_factory):
+    out = tmp_path_factory.mktemp("dsj")
+    path, truth = generate_synthetic_dataset(
+        out, nrows=12, ncols=12, formulas=None, present_fraction=0.5,
+        noise_peaks=60, seed=23,
+    )
+    return SpectralDataset.from_imzml(path), truth
+
+
+def test_cc_count_matches_scipy():
+    import jax.numpy as jnp
+    from scipy import ndimage
+    from sm_distributed_tpu.ops.metrics_jax import _cc_count
+
+    rng = np.random.default_rng(0)
+    structure4 = np.array([[0, 1, 0], [1, 1, 1], [0, 1, 0]])
+    for density in (0.1, 0.3, 0.5, 0.7, 0.9):
+        for _ in range(5):
+            mask = rng.random((17, 23)) < density
+            want = ndimage.label(mask, structure=structure4)[1]
+            got = int(_cc_count(jnp.asarray(mask.ravel()), 17, 23))
+            assert got == want, f"density={density}: {got} != {want}"
+    # serpentine worm: one long snaking component (stresses propagation depth —
+    # geodesic length ~ R*C/2 across a 16x16 grid) plus one isolated pixel
+    mask = np.zeros((16, 16), dtype=bool)
+    for r in range(0, 16, 2):
+        mask[r, :] = True                       # full horizontal runs
+        if r + 1 < 16:                          # connectors alternate sides
+            mask[r + 1, 15 if (r // 2) % 2 == 0 else 0] = True
+    mask[15, 15] = False
+    mask[15, 0] = False
+    mask[13, 7] = mask[13, 8] = False           # keep rows 12/14 joined only via edge
+    want = ndimage.label(mask, structure=structure4)[1]
+    assert want >= 1
+    got = int(_cc_count(jnp.asarray(mask.ravel()), 16, 16))
+    assert got == want
+    # explicit single-serpentine check on a bigger grid
+    snake = np.zeros((20, 20), dtype=bool)
+    for r in range(0, 20, 2):
+        snake[r, :] = True
+        if r + 1 < 20:
+            snake[r + 1, 19 if (r // 2) % 2 == 0 else 0] = True
+    want = ndimage.label(snake, structure=structure4)[1]
+    assert want == 1                            # truly one serpentine component
+    got = int(_cc_count(jnp.asarray(snake.ravel()), 20, 20))
+    assert got == want
+
+
+def test_chaos_batch_matches_numpy():
+    import jax.numpy as jnp
+    from sm_distributed_tpu.ops.metrics_jax import measure_of_chaos_batch
+    from sm_distributed_tpu.ops.metrics_np import measure_of_chaos
+
+    rng = np.random.default_rng(3)
+    imgs = []
+    yy, xx = np.mgrid[0:14, 0:14]
+    imgs.append(np.exp(-((yy - 7) ** 2 + (xx - 7) ** 2) / 9.0) * (rng.random((14, 14)) > 0.1))
+    imgs.append((rng.random((14, 14)) < 0.3) * rng.random((14, 14)))
+    imgs.append(np.zeros((14, 14)))
+    imgs.append(np.ones((14, 14)))
+    batch = np.stack([im.ravel().astype(np.float32) for im in imgs])
+    got = np.asarray(measure_of_chaos_batch(jnp.asarray(batch), 14, 14, nlevels=30))
+    want = np.array([measure_of_chaos(im.astype(np.float32), 30) for im in imgs])
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_hotspot_clip_batch_matches_numpy():
+    import jax.numpy as jnp
+    from sm_distributed_tpu.ops.metrics_jax import hotspot_clip_batch
+    from sm_distributed_tpu.ops.metrics_np import hotspot_clip
+
+    rng = np.random.default_rng(5)
+    imgs = rng.exponential(1.0, size=(6, 100)).astype(np.float32)
+    imgs[imgs < 0.3] = 0.0
+    imgs[3] = 0.0
+    got = np.asarray(hotspot_clip_batch(jnp.asarray(imgs), 99.0))
+    want = np.stack([hotspot_clip(im.astype(np.float64), 99.0) for im in imgs])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_extraction_parity(fixture_ds):
+    import jax
+    import jax.numpy as jnp
+    from sm_distributed_tpu.ops.imager_jax import (
+        cumulative_intensities, extract_images, prepare_cube_arrays,
+    )
+    from sm_distributed_tpu.ops.imager_np import extract_ion_images
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.ops.quantize import quantize_window
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    ds, truth = fixture_ds
+    calc = IsocalcWrapper(IsotopeGenerationConfig(adducts=("+H",)))
+    table = calc.pattern_table([(sf, "+H") for sf in truth.formulas[:20]])
+
+    want = extract_ion_images(ds, table, ppm=3.0)
+
+    mz_q, int_cube = prepare_cube_arrays(ds)
+    cum = cumulative_intensities(jnp.asarray(int_cube))
+    lo, hi = quantize_window(table.mzs, 3.0)
+    got = np.asarray(
+        extract_images(jnp.asarray(mz_q), cum, jnp.asarray(lo.ravel()),
+                       jnp.asarray(hi.ravel()))
+    ).reshape(table.n_ions, table.max_peaks, -1)[:, :, : ds.n_pixels]
+    # identical hit sets by construction; float32 cumsum-diff vs f64 bincount
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
+    # exact zero/nonzero support parity (window membership identical)
+    np.testing.assert_array_equal(got != 0, want != 0)
+
+
+def _run(ds, formulas, backend, decoy_n=6, seed=9, batch=64, preprocessing=False):
+    sm_config = SMConfig.from_dict(
+        {"backend": backend, "fdr": {"decoy_sample_size": decoy_n, "seed": seed},
+         "parallel": {"formula_batch": batch}}
+    )
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0, "do_preprocessing": preprocessing}}
+    )
+    return MSMBasicSearch(ds, formulas, ds_config, sm_config).search()
+
+
+@pytest.mark.parametrize("preprocessing", [False, True])
+def test_backend_parity_metrics_and_ranks(fixture_ds, preprocessing):
+    ds, truth = fixture_ds
+    formulas = truth.formulas
+    b_np = _run(ds, formulas, "numpy_ref", preprocessing=preprocessing)
+    b_jx = _run(ds, formulas, "jax_tpu", preprocessing=preprocessing)
+
+    m_np = b_np.all_metrics.set_index(["sf", "adduct"]).sort_index()
+    m_jx = b_jx.all_metrics.set_index(["sf", "adduct"]).sort_index()
+    assert list(m_np.index) == list(m_jx.index)
+    for col, tol in [("chaos", 5e-3), ("spatial", 1e-4), ("spectral", 1e-4),
+                     ("msm", 5e-3)]:
+        np.testing.assert_allclose(
+            m_jx[col].to_numpy(), m_np[col].to_numpy(), atol=tol,
+            err_msg=f"metric {col} diverges between backends",
+        )
+
+    # identical FDR ranks (north star) modulo numerically-tied neighbours
+    a_np = b_np.annotations
+    a_jx = b_jx.annotations
+    order_np = list(a_np.sf)
+    order_jx = list(a_jx.sf)
+    if order_np != order_jx:
+        msm_np = dict(zip(a_np.sf, a_np.msm))
+        for x, y in zip(order_np, order_jx):
+            if x != y:
+                assert abs(msm_np[x] - msm_np[y]) < 1e-3, (
+                    f"rank flip between non-tied ions {x} vs {y}"
+                )
+    # FDR level assignment agrees
+    lv_np = dict(zip(a_np.sf, a_np.fdr_level))
+    lv_jx = dict(zip(a_jx.sf, a_jx.fdr_level))
+    diffs = {sf for sf in lv_np if lv_np[sf] != lv_jx[sf]}
+    assert len(diffs) <= 1, f"fdr_level mismatches: {diffs}"
+
+
+def test_jax_batch_padding_consistency(fixture_ds):
+    # results must not depend on formula_batch (padding correctness)
+    ds, truth = fixture_ds
+    formulas = truth.formulas[:10]
+    r_small = _run(ds, formulas, "jax_tpu", batch=4).all_metrics
+    r_big = _run(ds, formulas, "jax_tpu", batch=64).all_metrics
+    pd.testing.assert_frame_equal(
+        r_small.sort_values(["sf", "adduct"]).reset_index(drop=True),
+        r_big.sort_values(["sf", "adduct"]).reset_index(drop=True),
+    )
