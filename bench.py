@@ -1,0 +1,119 @@
+"""Benchmark: ions scored per second per chip (jax_tpu fused graph).
+
+Primary metric per BASELINE.json ("formulas scored/sec/chip"): throughput of
+the fused extract+score XLA graph — ion-image extraction + MSM metrics
+(chaos, spatial, spectral) — over a synthetic spheroid-like dataset.
+``vs_baseline`` is the speedup over the numpy_ref backend on the same
+workload (the measured stand-in for the reference's Spark executor; the
+reference publishes no numbers — SURVEY.md §6, BASELINE.json "published": {}).
+
+Prints ONE JSON line on stdout; all logging goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nrows", type=int, default=64)
+    ap.add_argument("--ncols", type=int, default=64)
+    ap.add_argument("--decoy-sample-size", type=int, default=20)
+    ap.add_argument("--formula-batch", type=int, default=512)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--baseline-ions", type=int, default=48,
+                    help="ions timed on numpy_ref (per-ion rate extrapolates)")
+    args = ap.parse_args()
+
+    from sm_distributed_tpu.io.dataset import SpectralDataset
+    from sm_distributed_tpu.io.fixtures import FIXTURE_FORMULAS, generate_synthetic_dataset
+    from sm_distributed_tpu.models.msm_basic import NumpyBackend, make_backend
+    from sm_distributed_tpu.ops.fdr import FDR
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+    from sm_distributed_tpu.utils.logger import init_logger, logger
+
+    init_logger()
+    cache_dir = Path(__file__).parent / ".cache"
+    work_dir = cache_dir / "bench_ds"
+
+    t0 = time.perf_counter()
+    path, truth = generate_synthetic_dataset(
+        work_dir, nrows=args.nrows, ncols=args.ncols,
+        formulas=FIXTURE_FORMULAS, present_fraction=0.6, noise_peaks=200, seed=7,
+    )
+    ds = SpectralDataset.from_imzml(path)
+    logger.info("dataset: %dx%d px, %d peaks (%.1fs)",
+                ds.nrows, ds.ncols, ds.n_peaks, time.perf_counter() - t0)
+
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}}
+    )
+    sm_config = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "fdr": {"decoy_sample_size": args.decoy_sample_size},
+         "parallel": {"formula_batch": args.formula_batch}}
+    )
+    SMConfig.set(sm_config)
+
+    # Full target+decoy ion table (the realistic scoring workload).
+    fdr = FDR(decoy_sample_size=args.decoy_sample_size,
+              target_adducts=("+H",), seed=42)
+    assignment = fdr.decoy_adduct_selection(truth.formulas)
+    pairs, flags = assignment.all_ion_tuples(truth.formulas, ("+H",))
+    calc = IsocalcWrapper(ds_config.isotope_generation, cache_dir=str(cache_dir / "isocalc"))
+    t0 = time.perf_counter()
+    table = calc.pattern_table(pairs, flags)
+    logger.info("isotope patterns: %d ions (%.1fs)", table.n_ions, time.perf_counter() - t0)
+
+    from sm_distributed_tpu.models.msm_basic import _slice_table
+
+    def batches(n, b):
+        return [(s, min(s + b, n)) for s in range(0, n, b)]
+
+    # --- jax_tpu timing (compile excluded via warmup) -------------------
+    backend = make_backend("jax_tpu", ds, ds_config, sm_config)
+    b = args.formula_batch
+    warm = _slice_table(table, 0, min(b, table.n_ions))
+    t0 = time.perf_counter()
+    backend.score_batch(warm)
+    logger.info("jax warmup/compile: %.1fs", time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    n_scored = 0
+    for _ in range(args.reps):
+        for s, e in batches(table.n_ions, b):
+            backend.score_batch(_slice_table(table, s, e))
+            n_scored += e - s
+    jax_dt = time.perf_counter() - t0
+    jax_rate = n_scored / jax_dt
+    logger.info("jax_tpu: %d ions in %.2fs -> %.1f ions/s", n_scored, jax_dt, jax_rate)
+
+    # --- numpy_ref floor (subset, extrapolated per-ion) -----------------
+    np_backend = NumpyBackend(ds, ds_config)
+    sub = _slice_table(table, 0, min(args.baseline_ions, table.n_ions))
+    np_backend.score_batch(_slice_table(table, 0, 2))  # warm caches
+    t0 = time.perf_counter()
+    np_backend.score_batch(sub)
+    np_dt = time.perf_counter() - t0
+    np_rate = sub.n_ions / np_dt
+    logger.info("numpy_ref: %d ions in %.2fs -> %.1f ions/s", sub.n_ions, np_dt, np_rate)
+
+    print(json.dumps({
+        "metric": "ions_scored_per_sec_per_chip",
+        "value": round(jax_rate, 2),
+        "unit": "ions/s",
+        "vs_baseline": round(jax_rate / np_rate, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,9 +69,9 @@ def make_backend(name: str, ds: SpectralDataset, ds_config: DSConfig,
     if name == "numpy_ref":
         return NumpyBackend(ds, ds_config)
     if name == "jax_tpu":
-        from .msm_jax import JaxBackend  # deferred: jax import is heavy
+        from ..parallel.sharded import make_jax_backend  # deferred: jax import is heavy
 
-        return JaxBackend(ds, ds_config, sm_config)
+        return make_jax_backend(ds, ds_config, sm_config)
     raise ValueError(f"unknown backend {name!r}")
 
 
@@ -103,6 +103,9 @@ class MSMBasicSearch:
         self.isocalc = IsocalcWrapper(
             ds_config.isotope_generation, cache_dir=isocalc_cache_dir
         )
+        # populated by search(); the orchestrator reads it to persist ion
+        # images / m/z values for annotated ions (engine/search_job.py)
+        self.last_table: IsotopePatternTable | None = None
 
     _ANN_COLUMNS = ["sf", "adduct", "msm", "fdr", "fdr_level",
                     "chaos", "spatial", "spectral"]
@@ -127,6 +130,7 @@ class MSMBasicSearch:
             pairs, flags = assignment.all_ion_tuples(self.formulas, iso_cfg.adducts)
         with phase_timer("isotope_patterns", timings):
             table = self.isocalc.pattern_table(pairs, flags)
+        self.last_table = table
         logger.info(
             "scoring %d ions (%d targets, %d decoys) with backend=%s",
             table.n_ions, int(table.targets.sum()),

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.dataset import SpectralDataset
-from ..ops.imager_jax import cumulative_intensities, extract_images, prepare_cube_arrays
+from ..ops.imager_jax import extract_images, prepare_cube_arrays, window_rank_grid
 from ..ops.isocalc import IsotopePatternTable
 from ..ops.metrics_jax import batch_metrics
 from ..ops.quantize import quantize_window
@@ -29,9 +29,10 @@ from ..utils.logger import logger
 
 def fused_score_fn(
     mz_q_cube: jnp.ndarray,    # (P_pad, L) int32
-    cum_int: jnp.ndarray,      # (P_pad, L+1) f32
-    lo_q: jnp.ndarray,         # (B, K) int32
-    hi_q: jnp.ndarray,         # (B, K) int32
+    int_cube: jnp.ndarray,     # (P_pad, L) f32
+    grid: jnp.ndarray,         # (2*B*K,) int32 sorted window bounds
+    r_lo: jnp.ndarray,         # (B, K) int32 grid ranks
+    r_hi: jnp.ndarray,         # (B, K) int32 grid ranks
     theor_ints: jnp.ndarray,   # (B, K) f32
     n_valid: jnp.ndarray,      # (B,) i32
     *,
@@ -42,8 +43,8 @@ def fused_score_fn(
     q: float,
 ) -> jnp.ndarray:
     """images -> metrics for one formula batch: (B, 4). One XLA graph."""
-    b, k = lo_q.shape
-    imgs = extract_images(mz_q_cube, cum_int, lo_q.ravel(), hi_q.ravel())
+    b, k = r_lo.shape
+    imgs = extract_images(mz_q_cube, int_cube, grid, r_lo.ravel(), r_hi.ravel())
     imgs = imgs.reshape(b, k, -1)[:, :, : nrows * ncols]   # drop padded pixels
     return batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
@@ -65,7 +66,7 @@ class JaxBackend:
 
         mz_q, int_cube = prepare_cube_arrays(ds)
         self._mz_q = jax.device_put(mz_q)
-        self._cum = cumulative_intensities(jax.device_put(int_cube))
+        self._ints = jax.device_put(int_cube)
         logger.info(
             "jax_tpu cube resident: %s int32 + %s f32 on %s",
             mz_q.shape, int_cube.shape, self._mz_q.devices(),
@@ -96,5 +97,9 @@ class JaxBackend:
         lo_p[:n], hi_p[:n] = lo_q, hi_q
         ints_p[:n] = table.ints
         nv_p[:n] = table.n_valid
-        out = self._fn(self._mz_q, self._cum, lo_p, hi_p, ints_p, nv_p)
+        grid, r_lo, r_hi = window_rank_grid(lo_p, hi_p)
+        out = self._fn(
+            self._mz_q, self._ints, grid,
+            r_lo.reshape(b, k), r_hi.reshape(b, k), ints_p, nv_p,
+        )
         return np.asarray(out)[:n].astype(np.float64)

@@ -4,12 +4,16 @@ Device-side counterparts of ops/metrics_np.py (the parity oracle):
 
 - ``measure_of_chaos``: connected components without dynamic shapes — the
   genuinely hard TPU kernel (SURVEY.md §7 hard part 1).  Implemented as
-  min-label propagation with pointer jumping (the classic parallel
-  connected-components scheme): labels start as pixel indices, each step
-  takes the 4-neighbour minimum and then compresses chains by gathering
-  labels through themselves; a ``lax.while_loop`` runs to the exact fixpoint
-  (component count = #pixels whose final label equals their own index), so
-  counts match scipy.ndimage.label exactly.
+  min-label propagation via SEGMENTED MIN-SCANS: labels start as pixel
+  indices; one sweep runs four ``lax.associative_scan`` passes (rows
+  left/right, columns down/up) whose combine op resets at mask boundaries,
+  so a label floods an entire straight run in O(log n) steps; a
+  ``lax.while_loop`` sweeps to the exact fixpoint (component count =
+  #pixels whose final label equals their own index), matching
+  scipy.ndimage.label exactly.  Design note: an earlier pointer-jumping
+  variant (gather-based label compression) was ~200x slower on TPU — VPU
+  scans beat gathers by orders of magnitude; iterations-to-fixpoint equals
+  the component "zigzag depth", small for real ion images.
 - correlation / pattern match: masked dot products, trivially vmapped.
 
 All functions take a whole formula batch and are designed to live inside one
@@ -25,26 +29,36 @@ import jax.numpy as jnp
 from jax import lax
 
 
+_BIG = jnp.int32(2**30)
+
+
+def _seg_min_scan(vals: jnp.ndarray, resets: jnp.ndarray, axis: int,
+                  reverse: bool) -> jnp.ndarray:
+    """Segmented running minimum: the min restarts wherever ``resets`` is
+    True (mask boundaries), so labels flood only within contiguous runs."""
+
+    def comb(a, b):
+        av, ar = a
+        bv, br = b
+        return (jnp.where(br, bv, jnp.minimum(av, bv)), ar | br)
+
+    v, _ = lax.associative_scan(comb, (vals, resets), axis=axis, reverse=reverse)
+    return v
+
+
 def _cc_count(mask_flat: jnp.ndarray, nrows: int, ncols: int) -> jnp.ndarray:
     """Exact 4-connectivity component count of a boolean (nrows*ncols,) mask."""
-    n_pix = nrows * ncols
-    iota = jnp.arange(n_pix, dtype=jnp.int32)
-    big = jnp.int32(n_pix)
-    labels0 = jnp.where(mask_flat, iota, big)
+    m = mask_flat.reshape(nrows, ncols)
+    iota = jnp.arange(nrows * ncols, dtype=jnp.int32).reshape(nrows, ncols)
+    labels0 = jnp.where(m, iota, _BIG)
+    resets = ~m
 
-    def one_iter(labels):
-        lab = labels.reshape(nrows, ncols)
-        up = jnp.concatenate([jnp.full((1, ncols), big, jnp.int32), lab[:-1]], axis=0)
-        down = jnp.concatenate([lab[1:], jnp.full((1, ncols), big, jnp.int32)], axis=0)
-        left = jnp.concatenate([jnp.full((nrows, 1), big, jnp.int32), lab[:, :-1]], axis=1)
-        right = jnp.concatenate([lab[:, 1:], jnp.full((nrows, 1), big, jnp.int32)], axis=1)
-        nmin = jnp.minimum(jnp.minimum(up, down), jnp.minimum(left, right)).ravel()
-        lab_new = jnp.where(mask_flat, jnp.minimum(labels, nmin), big)
-        # pointer jumping (x2): follow label -> label-of-label to compress chains
-        for _ in range(2):
-            g = lab_new[jnp.clip(lab_new, 0, n_pix - 1)]
-            lab_new = jnp.where(lab_new < big, g, big)
-        return lab_new
+    def sweep(lab):
+        lab = _seg_min_scan(lab, resets, axis=1, reverse=False)
+        lab = _seg_min_scan(lab, resets, axis=1, reverse=True)
+        lab = _seg_min_scan(lab, resets, axis=0, reverse=False)
+        lab = _seg_min_scan(lab, resets, axis=0, reverse=True)
+        return jnp.where(m, lab, _BIG)
 
     def cond(state):
         labels, prev = state
@@ -52,10 +66,10 @@ def _cc_count(mask_flat: jnp.ndarray, nrows: int, ncols: int) -> jnp.ndarray:
 
     def body(state):
         labels, _ = state
-        return one_iter(labels), labels
+        return sweep(labels), labels
 
-    labels, _ = lax.while_loop(cond, body, (one_iter(labels0), labels0))
-    return jnp.sum((labels == iota) & mask_flat)
+    labels, _ = lax.while_loop(cond, body, (sweep(labels0), labels0))
+    return jnp.sum((labels == iota) & m)
 
 
 def measure_of_chaos_batch(

@@ -1,0 +1,18 @@
+"""Mesh-sharded distributed runtime (the reference's Spark layer, TPU-native).
+
+- mesh:    ("pixels", "formulas") device-mesh construction from config.
+- sharded: shard_map fused extract+score step + multi-chip backend.
+"""
+
+from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh, resolve_axis_sizes
+from .sharded import ShardedJaxBackend, build_sharded_score_fn, make_jax_backend
+
+__all__ = [
+    "FORMULAS_AXIS",
+    "PIXELS_AXIS",
+    "make_mesh",
+    "resolve_axis_sizes",
+    "ShardedJaxBackend",
+    "build_sharded_score_fn",
+    "make_jax_backend",
+]

@@ -1,0 +1,62 @@
+"""Device-mesh construction — the TPU-native replacement for the reference's
+Spark cluster topology.
+
+The reference expresses parallelism as Spark settings (``spark.master``,
+executor counts — ``sm_config['spark']`` [U], SURVEY.md #20) and its data
+layout as RDD partitions over the pixel axis plus broadcast peak tables
+(SURVEY.md §2d).  Here the same two degrees of freedom are mesh axes:
+
+- ``"pixels"``  — shards the spectral cube's pixel dimension (the RDD
+  partition analog; BASELINE config #5: >200k-pixel DESI slide on v4-32).
+- ``"formulas"`` — shards the formula-batch dimension (the analog of
+  parallelizing over (sf, adduct) pairs; BASELINE config #4).
+
+Axis sizes come from ``SMConfig.parallel`` where ``-1`` means "use all
+remaining devices".  A 1x1 mesh degrades gracefully to the single-device
+fused graph (models/msm_jax.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils.config import ParallelConfig
+
+PIXELS_AXIS = "pixels"
+FORMULAS_AXIS = "formulas"
+
+
+def resolve_axis_sizes(n_devices: int, cfg: ParallelConfig) -> tuple[int, int]:
+    """(pixels, formulas) axis sizes using exactly their product <= n_devices.
+
+    ``-1`` entries absorb all devices left over after the explicit axes.
+    Both -1: all devices go to the pixel axis (the dominant data axis).
+    """
+    pix, form = cfg.pixels_axis, cfg.formulas_axis
+    if pix == 0 or form == 0:
+        raise ValueError("mesh axis sizes must be -1 or positive")
+    if pix == -1 and form == -1:
+        pix, form = n_devices, 1
+    elif pix == -1:
+        if n_devices % form:
+            raise ValueError(f"formulas_axis={form} does not divide {n_devices} devices")
+        pix = n_devices // form
+    elif form == -1:
+        if n_devices % pix:
+            raise ValueError(f"pixels_axis={pix} does not divide {n_devices} devices")
+        form = n_devices // pix
+    if pix * form > n_devices:
+        raise ValueError(
+            f"mesh {pix}x{form} needs {pix * form} devices, only {n_devices} available"
+        )
+    return pix, form
+
+
+def make_mesh(cfg: ParallelConfig, devices=None) -> Mesh:
+    """Build the ("pixels", "formulas") mesh from config + available devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    pix, form = resolve_axis_sizes(len(devices), cfg)
+    dev_grid = np.array(devices[: pix * form]).reshape(pix, form)
+    return Mesh(dev_grid, (PIXELS_AXIS, FORMULAS_AXIS))

@@ -1,0 +1,178 @@
+"""Mesh-sharded fused extract+score graph (multi-chip path).
+
+TPU-native replacement for the reference's distributed runtime (SURVEY.md
+§5.8): where the reference broadcasts peak tables and runs a cluster-wide
+``groupByKey`` shuffle of (ion, pixel, intensity) hits
+(``formula_imager_segm.compute_sf_images`` [U], §3.3), here:
+
+- the spectral cube is resident in HBM, sharded over the ``"pixels"`` mesh
+  axis (``NamedSharding(mesh, P("pixels", None))``) — the RDD-partition analog;
+- the isotope window/intensity tables are sharded over ``"formulas"`` and
+  replicated over ``"pixels"`` — the broadcast analog (XLA materializes it as
+  an all-gather over ICI);
+- the shuffle becomes a single ``all_gather`` of per-shard image slices along
+  the pixel axis inside ``shard_map`` — each device then scores its formula
+  shard locally.  One collective per batch, riding ICI, in the same fused XLA
+  graph as extraction and metrics.
+
+The whole step stays a single jitted program per dataset (static shapes), so
+multi-chip keeps the north star's one-fused-graph property per batch.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..io.dataset import SpectralDataset
+from ..ops.imager_jax import extract_images, prepare_cube_arrays, window_rank_grid
+from ..ops.isocalc import IsotopePatternTable
+from ..ops.metrics_jax import batch_metrics
+from ..ops.quantize import quantize_window
+from ..utils.config import DSConfig, SMConfig
+from ..utils.logger import logger
+from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh
+
+
+def build_sharded_score_fn(
+    mesh: Mesh,
+    *,
+    nrows: int,
+    ncols: int,
+    nlevels: int,
+    do_preprocessing: bool,
+    q: float,
+):
+    """Jitted sharded step: (cube shards, window shards) -> (B, 4) metrics.
+
+    Layouts: mz_q_cube/int_cube sharded P("pixels", None); the window-bound
+    grid + ranks are built per formula shard on host (each shard histograms
+    only its own windows' bounds) and sharded P("formulas", ...); output
+    sharded P("formulas", None).
+    """
+
+    def step(mz_q_cube, int_cube, grid, r_lo, r_hi, theor_ints, n_valid):
+        # Per-device block: cube (P_loc, L); windows (B_loc, K); grid (G_loc,).
+        b, k = r_lo.shape
+        imgs_loc = extract_images(mz_q_cube, int_cube, grid, r_lo.ravel(), r_hi.ravel())
+        # The "shuffle": reassemble full images from pixel shards over ICI.
+        imgs = jax.lax.all_gather(imgs_loc, PIXELS_AXIS, axis=1, tiled=True)
+        imgs = imgs.reshape(b, k, -1)[:, :, : nrows * ncols]
+        return batch_metrics(
+            imgs, theor_ints, n_valid, nrows, ncols, nlevels,
+            do_preprocessing=do_preprocessing, q=q,
+        )
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(
+            P(PIXELS_AXIS, None),      # mz_q_cube
+            P(PIXELS_AXIS, None),      # int_cube
+            P(FORMULAS_AXIS),          # grid (concatenated per-shard grids)
+            P(FORMULAS_AXIS, None),    # r_lo
+            P(FORMULAS_AXIS, None),    # r_hi
+            P(FORMULAS_AXIS, None),    # theor_ints
+            P(FORMULAS_AXIS),          # n_valid
+        ),
+        out_specs=P(FORMULAS_AXIS, None),
+        # The output IS replicated over "pixels": every pixels-shard computes
+        # metrics from the identical all_gather-ed full images.  JAX's VMA
+        # type system can't infer replication through tiled all_gather (no
+        # all_gather_invariant in jax 0.9), so the static check is disabled.
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+class ShardedJaxBackend:
+    """Multi-chip scorer: same interface/semantics as models.msm_jax.JaxBackend,
+    data sharded over the ("pixels", "formulas") mesh."""
+
+    name = "jax_tpu"
+
+    def __init__(
+        self,
+        ds: SpectralDataset,
+        ds_config: DSConfig,
+        sm_config: SMConfig,
+        mesh: Mesh | None = None,
+    ):
+        self.ds = ds
+        self.ds_config = ds_config
+        self.mesh = mesh if mesh is not None else make_mesh(sm_config.parallel)
+        n_pix_shards = self.mesh.shape[PIXELS_AXIS]
+        n_form_shards = self.mesh.shape[FORMULAS_AXIS]
+        # Static batch padded so the formula axis divides evenly.
+        self.batch = _round_up(max(1, sm_config.parallel.formula_batch), n_form_shards)
+        img_cfg = ds_config.image_generation
+        self.ppm = img_cfg.ppm
+
+        mz_q, int_cube = prepare_cube_arrays(ds, pixels_multiple=n_pix_shards)
+        cube_sharding = NamedSharding(self.mesh, P(PIXELS_AXIS, None))
+        self._mz_q = jax.device_put(mz_q, cube_sharding)
+        self._ints = jax.device_put(int_cube, cube_sharding)
+        self._form_sharding = NamedSharding(self.mesh, P(FORMULAS_AXIS, None))
+        self._nv_sharding = NamedSharding(self.mesh, P(FORMULAS_AXIS))
+        self._n_form_shards = n_form_shards
+        logger.info(
+            "jax_tpu sharded cube resident: %s over mesh %s (pixels=%d, formulas=%d)",
+            mz_q.shape, dict(self.mesh.shape), n_pix_shards, n_form_shards,
+        )
+        self._fn = build_sharded_score_fn(
+            self.mesh,
+            nrows=ds.nrows,
+            ncols=ds.ncols,
+            nlevels=img_cfg.nlevels,
+            do_preprocessing=img_cfg.do_preprocessing,
+            q=img_cfg.q,
+        )
+
+    def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
+        n = table.n_ions
+        b = self.batch
+        if n > b:
+            raise ValueError(f"batch of {n} ions exceeds formula_batch={b}")
+        k = table.max_peaks
+        lo_q, hi_q = quantize_window(table.mzs, self.ppm)
+        lo_p = np.zeros((b, k), dtype=np.int32)
+        hi_p = np.zeros((b, k), dtype=np.int32)
+        ints_p = np.zeros((b, k), dtype=np.float32)
+        nv_p = np.zeros(b, dtype=np.int32)
+        lo_p[:n], hi_p[:n] = lo_q, hi_q
+        ints_p[:n] = table.ints
+        nv_p[:n] = table.n_valid
+        # Per-formula-shard bound grids: shard f histograms only its windows.
+        f = self._n_form_shards
+        b_loc = b // f
+        grids, r_los, r_his = [], [], []
+        for s in range(f):
+            sl = slice(s * b_loc, (s + 1) * b_loc)
+            g, rl, rh = window_rank_grid(lo_p[sl], hi_p[sl])
+            grids.append(g)
+            r_los.append(rl.reshape(b_loc, k))
+            r_his.append(rh.reshape(b_loc, k))
+        grid_d = jax.device_put(np.concatenate(grids), self._nv_sharding)
+        rlo_d = jax.device_put(np.concatenate(r_los), self._form_sharding)
+        rhi_d = jax.device_put(np.concatenate(r_his), self._form_sharding)
+        ints_d = jax.device_put(ints_p, self._form_sharding)
+        nv_d = jax.device_put(nv_p, self._nv_sharding)
+        out = self._fn(self._mz_q, self._ints, grid_d, rlo_d, rhi_d, ints_d, nv_d)
+        return np.asarray(out)[:n].astype(np.float64)
+
+
+def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConfig):
+    """Pick single-device fused graph or the mesh-sharded variant based on the
+    resolved mesh size (1x1 mesh -> single device, no collectives)."""
+    mesh = make_mesh(sm_config.parallel)
+    if mesh.size == 1:
+        from ..models.msm_jax import JaxBackend
+
+        return JaxBackend(ds, ds_config, sm_config)
+    return ShardedJaxBackend(ds, ds_config, sm_config, mesh=mesh)

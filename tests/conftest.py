@@ -10,12 +10,20 @@ before jax is imported anywhere, hence this top-of-conftest block.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+# The axon TPU plugin's sitecustomize forces jax_platforms="axon,cpu" at
+# interpreter boot, overriding the env var — force it back before any
+# backend initializes so tests run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) >= 8, "tests expect the 8-device virtual CPU mesh"
 
 import numpy as np
 import pytest

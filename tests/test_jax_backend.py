@@ -94,10 +94,9 @@ def test_hotspot_clip_batch_matches_numpy():
 
 
 def test_extraction_parity(fixture_ds):
-    import jax
     import jax.numpy as jnp
     from sm_distributed_tpu.ops.imager_jax import (
-        cumulative_intensities, extract_images, prepare_cube_arrays,
+        extract_images, prepare_cube_arrays, window_rank_grid,
     )
     from sm_distributed_tpu.ops.imager_np import extract_ion_images
     from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
@@ -111,13 +110,13 @@ def test_extraction_parity(fixture_ds):
     want = extract_ion_images(ds, table, ppm=3.0)
 
     mz_q, int_cube = prepare_cube_arrays(ds)
-    cum = cumulative_intensities(jnp.asarray(int_cube))
     lo, hi = quantize_window(table.mzs, 3.0)
+    grid, r_lo, r_hi = window_rank_grid(lo, hi)
     got = np.asarray(
-        extract_images(jnp.asarray(mz_q), cum, jnp.asarray(lo.ravel()),
-                       jnp.asarray(hi.ravel()))
+        extract_images(jnp.asarray(mz_q), jnp.asarray(int_cube),
+                       jnp.asarray(grid), jnp.asarray(r_lo), jnp.asarray(r_hi))
     ).reshape(table.n_ions, table.max_peaks, -1)[:, :, : ds.n_pixels]
-    # identical hit sets by construction; float32 cumsum-diff vs f64 bincount
+    # identical hit sets by construction; f32 histogram-cumsum vs f64 bincount
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-3)
     # exact zero/nonzero support parity (window membership identical)
     np.testing.assert_array_equal(got != 0, want != 0)

@@ -1,0 +1,127 @@
+"""Mesh-sharded runtime tests on the virtual 8-device CPU mesh — the analog
+of the reference testing its Spark code in ``local[*]`` mode (SURVEY.md §4):
+the real collective/sharding code paths run single-machine."""
+
+import numpy as np
+import pytest
+
+from sm_distributed_tpu.io.dataset import SpectralDataset
+from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+from sm_distributed_tpu.utils.config import (
+    DSConfig,
+    IsotopeGenerationConfig,
+    ParallelConfig,
+    SMConfig,
+)
+
+
+@pytest.fixture(scope="module")
+def fixture_ds(tmp_path_factory):
+    out = tmp_path_factory.mktemp("dsp")
+    path, truth = generate_synthetic_dataset(
+        out, nrows=10, ncols=14, present_fraction=0.5, noise_peaks=50, seed=31,
+    )
+    return SpectralDataset.from_imzml(path), truth
+
+
+def _table(truth, n=16):
+    calc = IsocalcWrapper(IsotopeGenerationConfig(adducts=("+H",)))
+    return calc.pattern_table([(sf, "+H") for sf in truth.formulas[:n]])
+
+
+def test_resolve_axis_sizes():
+    from sm_distributed_tpu.parallel.mesh import resolve_axis_sizes
+
+    assert resolve_axis_sizes(8, ParallelConfig(pixels_axis=-1, formulas_axis=1)) == (8, 1)
+    assert resolve_axis_sizes(8, ParallelConfig(pixels_axis=-1, formulas_axis=2)) == (4, 2)
+    assert resolve_axis_sizes(8, ParallelConfig(pixels_axis=2, formulas_axis=-1)) == (2, 4)
+    assert resolve_axis_sizes(8, ParallelConfig(pixels_axis=-1, formulas_axis=-1)) == (8, 1)
+    assert resolve_axis_sizes(1, ParallelConfig(pixels_axis=-1, formulas_axis=1)) == (1, 1)
+    with pytest.raises(ValueError):
+        resolve_axis_sizes(8, ParallelConfig(pixels_axis=-1, formulas_axis=3))
+    with pytest.raises(ValueError):
+        resolve_axis_sizes(4, ParallelConfig(pixels_axis=8, formulas_axis=1))
+
+
+def test_make_mesh_axes():
+    from sm_distributed_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(ParallelConfig(pixels_axis=4, formulas_axis=2))
+    assert mesh.axis_names == ("pixels", "formulas")
+    assert dict(mesh.shape) == {"pixels": 4, "formulas": 2}
+
+
+@pytest.mark.parametrize("pix,form", [(8, 1), (4, 2), (2, 4)])
+def test_sharded_matches_single_device(fixture_ds, pix, form):
+    from sm_distributed_tpu.models.msm_jax import JaxBackend
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
+
+    ds, truth = fixture_ds
+    table = _table(truth)
+    dc = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    sm_sharded = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "parallel": {"formula_batch": 32, "pixels_axis": pix, "formulas_axis": form}}
+    )
+    sm_single = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "parallel": {"formula_batch": 32, "pixels_axis": 1, "formulas_axis": 1}}
+    )
+    got = ShardedJaxBackend(ds, dc, sm_sharded).score_batch(table)
+    want = JaxBackend(ds, dc, sm_single).score_batch(table)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_with_preprocessing(fixture_ds):
+    from sm_distributed_tpu.models.msm_jax import JaxBackend
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
+
+    ds, truth = fixture_ds
+    table = _table(truth, n=8)
+    dc = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"do_preprocessing": True}}
+    )
+    sm = SMConfig.from_dict(
+        {"parallel": {"formula_batch": 16, "pixels_axis": 4, "formulas_axis": 2}}
+    )
+    sm1 = SMConfig.from_dict(
+        {"parallel": {"formula_batch": 16, "pixels_axis": 1, "formulas_axis": 1}}
+    )
+    got = ShardedJaxBackend(ds, dc, sm).score_batch(table)
+    want = JaxBackend(ds, dc, sm1).score_batch(table)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_make_jax_backend_selects_sharded(fixture_ds):
+    from sm_distributed_tpu.models.msm_jax import JaxBackend
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend, make_jax_backend
+
+    ds, _ = fixture_ds
+    dc = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    multi = make_jax_backend(ds, dc, SMConfig.from_dict({"parallel": {"formula_batch": 16}}))
+    assert isinstance(multi, ShardedJaxBackend)
+    single = make_jax_backend(
+        ds, dc,
+        SMConfig.from_dict(
+            {"parallel": {"formula_batch": 16, "pixels_axis": 1, "formulas_axis": 1}}
+        ),
+    )
+    assert isinstance(single, JaxBackend)
+
+
+def test_sharded_batch_divisibility(fixture_ds):
+    # formula_batch not divisible by the formulas axis gets rounded up
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
+
+    ds, truth = fixture_ds
+    dc = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    sm = SMConfig.from_dict(
+        {"parallel": {"formula_batch": 5, "pixels_axis": 2, "formulas_axis": 4}}
+    )
+    backend = ShardedJaxBackend(ds, dc, sm)
+    assert backend.batch % 4 == 0
+    out = backend.score_batch(_table(truth, n=6))
+    assert out.shape == (6, 4)
+    assert np.isfinite(out).all()
