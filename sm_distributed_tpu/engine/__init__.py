@@ -1,0 +1,21 @@
+"""Job orchestration + state plane (the reference's L5/L0 layers, TPU-native).
+
+- search_job: SearchJob end-to-end orchestrator (SURVEY.md #13).
+- storage:    JobLedger (job/dataset status), SearchResultsStore (parquet +
+              sparse ion images), AnnotationIndex (the ES analog) (#2,#14,#15,#21).
+- work_dir:   input staging with existence-check resume (#3).
+- moldb:      molecular DB import/lookup (#18).
+- cli:        run_molecule_search-style CLI (#19).
+- daemon:     file-queue job intake, the RabbitMQ analog (#16).
+- png:        ion-image PNG rendering (#17).
+"""
+
+from .storage import AnnotationIndex, JobLedger, SearchResultsStore
+from .work_dir import WorkDirManager
+
+__all__ = [
+    "AnnotationIndex",
+    "JobLedger",
+    "SearchResultsStore",
+    "WorkDirManager",
+]

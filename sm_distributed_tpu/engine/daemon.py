@@ -1,0 +1,177 @@
+"""Queue daemon — file-queue job intake.
+
+Reference: ``sm/engine/queue.py::QueueConsumer`` + ``scripts/sm_daemon.py``
+[U] (SURVEY.md #16): RabbitMQ blocking consume on the ``sm_annotate`` queue;
+each message ``{ds_id, input_path, ds_config}`` runs a SearchJob; success →
+ack, failure → log + publish to a fail queue.
+
+Offline TPU-native equivalent with the same contract: a spool DIRECTORY is
+the queue.  ``QueuePublisher.publish`` drops ``<queue>/pending/<id>.json``;
+the daemon claims a message by atomically renaming it into ``running/``
+(rename is the ack/visibility mechanism — two daemons cannot claim the same
+message), runs the job, then moves it to ``done/`` or ``failed/`` (the fail
+queue).  Crash recovery: messages stuck in ``running/`` can be requeued with
+``requeue_stale()``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from pathlib import Path
+
+from ..utils.config import DSConfig, SMConfig
+from ..utils.logger import logger
+
+QUEUE_ANNOTATE = "sm_annotate"
+_STATES = ("pending", "running", "done", "failed")
+
+
+class QueuePublisher:
+    """Drop job messages into the spool queue (reference: QueuePublisher [U])."""
+
+    def __init__(self, queue_dir: str | Path, queue: str = QUEUE_ANNOTATE):
+        self.root = Path(queue_dir) / queue
+        for s in _STATES:
+            (self.root / s).mkdir(parents=True, exist_ok=True)
+
+    def publish(self, msg: dict) -> Path:
+        if "ds_id" not in msg or "input_path" not in msg:
+            raise ValueError("message needs at least ds_id and input_path")
+        msg_id = msg.get("msg_id") or uuid.uuid4().hex
+        msg = {**msg, "msg_id": msg_id, "published_at": time.time()}
+        tmp = self.root / "pending" / f".{msg_id}.tmp"
+        dst = self.root / "pending" / f"{msg_id}.json"
+        tmp.write_text(json.dumps(msg, indent=2))
+        os.replace(tmp, dst)          # atomic publish
+        return dst
+
+
+class QueueConsumer:
+    """Consume the spool queue, one message at a time (blocking poll loop)."""
+
+    def __init__(
+        self,
+        queue_dir: str | Path,
+        callback,
+        queue: str = QUEUE_ANNOTATE,
+        on_success=None,
+        on_failure=None,
+        poll_interval: float = 1.0,
+    ):
+        self.root = Path(queue_dir) / queue
+        for s in _STATES:
+            (self.root / s).mkdir(parents=True, exist_ok=True)
+        self.callback = callback
+        self.on_success = on_success
+        self.on_failure = on_failure
+        self.poll_interval = poll_interval
+        self._stop = False
+
+    def stop(self) -> None:
+        self._stop = True
+
+    def _claim(self) -> Path | None:
+        for p in sorted(self.root.glob("pending/*.json")):
+            dst = self.root / "running" / p.name
+            try:
+                os.replace(p, dst)    # atomic claim
+                return dst
+            except FileNotFoundError:
+                continue              # another consumer won the race
+        return None
+
+    def process_one(self) -> bool:
+        """Claim + process a single message. Returns False if queue empty."""
+        claimed = self._claim()
+        if claimed is None:
+            return False
+        msg: dict = {}
+        try:
+            msg = json.loads(claimed.read_text())
+            logger.info("queue: processing %s (ds %s)", claimed.name, msg.get("ds_id"))
+            self.callback(msg)
+        except Exception as exc:
+            # poison messages (bad JSON) land in failed/ too, instead of
+            # crash-looping the consumer
+            msg["error"] = str(exc)
+            (self.root / "failed" / claimed.name).write_text(json.dumps(msg, indent=2))
+            claimed.unlink()
+            logger.error("queue: %s FAILED: %s", claimed.name, exc)
+            if self.on_failure:
+                self.on_failure(msg, exc)
+        else:
+            os.replace(claimed, self.root / "done" / claimed.name)
+            logger.info("queue: %s done", claimed.name)
+            if self.on_success:
+                self.on_success(msg)
+        return True
+
+    def requeue_stale(self, max_age_s: float = 0.0) -> int:
+        """Move crashed messages from running/ back to pending/."""
+        n = 0
+        now = time.time()
+        for p in self.root.glob("running/*.json"):
+            if now - p.stat().st_mtime >= max_age_s:
+                os.replace(p, self.root / "pending" / p.name)
+                n += 1
+        return n
+
+    def run(self, max_messages: int | None = None) -> None:
+        """Blocking consume loop (the reference's pika blocking consume [U])."""
+        n = 0
+        while not self._stop:
+            if self.process_one():
+                n += 1
+                if max_messages is not None and n >= max_messages:
+                    return
+            else:
+                time.sleep(self.poll_interval)
+
+
+def annotate_callback(sm_config: SMConfig):
+    """Build the daemon callback running a SearchJob per message
+    (mirrors scripts/sm_daemon.py wiring [U])."""
+
+    def cb(msg: dict) -> None:
+        from .search_job import SearchJob
+
+        ds_config = (
+            DSConfig.from_dict(msg["ds_config"]) if msg.get("ds_config") else DSConfig()
+        )
+        SearchJob(
+            ds_id=msg["ds_id"],
+            ds_name=msg.get("ds_name", msg["ds_id"]),
+            input_path=msg["input_path"],
+            ds_config=ds_config,
+            sm_config=sm_config,
+            formulas=msg.get("formulas"),
+        ).run(clean=bool(msg.get("clean")))
+
+    return cb
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="sm-tpu-daemon")
+    ap.add_argument("queue_dir")
+    ap.add_argument("--sm-config", default=None)
+    ap.add_argument("--max-messages", type=int, default=None)
+    args = ap.parse_args(argv)
+    sm_config = SMConfig.set_path(args.sm_config) if args.sm_config else SMConfig.get_conf()
+    from ..utils.logger import init_logger
+
+    init_logger(sm_config.logs_dir or None)
+    consumer = QueueConsumer(args.queue_dir, annotate_callback(sm_config))
+    consumer.requeue_stale()
+    consumer.run(max_messages=args.max_messages)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

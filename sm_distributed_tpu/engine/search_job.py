@@ -1,0 +1,167 @@
+"""SearchJob — the end-to-end annotation job orchestrator (L5).
+
+Reference: ``sm/engine/search_job.py::SearchJob`` [U] (SURVEY.md #13, call
+stack §3.1): the one place that touches every layer — config, work-dir
+staging, conversion, distributed context, theor-peak generation, search,
+result storage, cleanup, with job status rows (STARTED/FINISHED/FAILED).
+
+TPU-native differences: no imzML→txt conversion step (the native reader
+parses straight into the device-friendly CSR layout); the Spark context is
+replaced by the jitted backend (mesh-aware via SMConfig.parallel); results go
+to parquet + sqlite instead of Postgres/ES.  Failure model per SURVEY.md
+§5.3: any exception marks the job FAILED with the error recorded, partial
+index entries for the dataset are removed, and re-running is idempotent.
+"""
+
+from __future__ import annotations
+
+import traceback
+from pathlib import Path
+
+from ..io.dataset import SpectralDataset
+from ..models.msm_basic import MSMBasicSearch, SearchResultsBundle
+from ..utils.config import DSConfig, SMConfig
+from ..utils.logger import logger, phase_timer
+from .moldb import MolecularDB
+from .storage import JobLedger, SearchResultsStore
+from .work_dir import WorkDirManager
+
+
+class SearchJob:
+    """Run a full annotation job for one dataset."""
+
+    def __init__(
+        self,
+        ds_id: str,
+        ds_name: str,
+        input_path: str | Path,
+        ds_config: DSConfig,
+        sm_config: SMConfig | None = None,
+        formulas: list[str] | None = None,
+        profile_dir: str | None = None,
+    ):
+        self.ds_id = ds_id
+        self.ds_name = ds_name
+        self.input_path = Path(input_path)
+        self.ds_config = ds_config
+        self.sm_config = sm_config or SMConfig.get_conf()
+        self.formulas = formulas      # explicit list overrides the mol DB
+        self.profile_dir = profile_dir
+        self.ledger = JobLedger(self.sm_config.storage.results_dir)
+        self.store = SearchResultsStore(
+            self.ledger,
+            store_images=self.sm_config.storage.store_images,
+            image_format=self.sm_config.storage.image_format,
+        )
+        self.work_dir = WorkDirManager(self.sm_config.work_dir, ds_id)
+
+    def _load_formulas(self) -> list[str]:
+        if self.formulas is not None:
+            return list(self.formulas)
+        db_cfg = self.ds_config.database
+        return MolecularDB(self.ledger).formulas(db_cfg.name, db_cfg.version)
+
+    def run(self, clean: bool = False) -> SearchResultsBundle:
+        """Stage → read → search → store; job row tracks status."""
+        import dataclasses
+
+        self.ledger.upsert_dataset(
+            self.ds_id, self.ds_name, str(self.input_path),
+            dataclasses.asdict(self.ds_config),
+        )
+        job_id = self.ledger.start_job(self.ds_id)
+        logger.info("job %d started for ds %s (%s)", job_id, self.ds_id, self.ds_name)
+        prof = None
+        try:
+            timings: dict[str, float] = {}
+            with phase_timer("stage_input", timings):
+                self.work_dir.copy_input_data(self.input_path)
+            with phase_timer("read_dataset", timings):
+                ds = SpectralDataset.from_imzml(self.work_dir.imzml_path())
+            logger.info(
+                "dataset %s: %dx%d px, %d spectra, %d peaks",
+                self.ds_id, ds.nrows, ds.ncols, ds.n_spectra, ds.n_peaks,
+            )
+            formulas = self._load_formulas()
+            if self.profile_dir:
+                import jax
+
+                prof = self.profile_dir
+                jax.profiler.start_trace(prof)
+            search = MSMBasicSearch(
+                ds, formulas, self.ds_config, self.sm_config,
+                isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),
+            )
+            bundle = search.search()
+            if prof:
+                import jax
+
+                jax.profiler.stop_trace()
+                prof = None
+                logger.info("profile trace written to %s", self.profile_dir)
+            bundle.timings.update(timings)
+            with phase_timer("store_results", bundle.timings):
+                ion_mzs = {
+                    (table_sf, table_ad): mz
+                    for table_sf, table_ad, mz in zip(
+                        search.last_table.sfs,
+                        search.last_table.adducts,
+                        search.last_table.mzs[:, 0],
+                    )
+                } if search.last_table is not None else None
+                self.store.store(self.ds_id, job_id, bundle, ion_mzs)
+                if self.sm_config.storage.store_images:
+                    self._store_annotation_images(ds, search, bundle)
+            self.ledger.finish_job(job_id)
+            logger.info("job %d FINISHED (%d annotations)", job_id, len(bundle.annotations))
+            return bundle
+        except Exception as exc:
+            if prof:
+                import jax
+
+                jax.profiler.stop_trace()
+            self.ledger.fail_job(job_id, f"{exc}\n{traceback.format_exc()}")
+            # remove THIS job's partial index entries (the reference's ES
+            # cleanup [U]); earlier successful jobs' rows stay queryable
+            self.store.index.delete_ds(self.ds_id, job_id=job_id)
+            logger.error("job %d FAILED: %s", job_id, exc)
+            raise
+        finally:
+            if clean:
+                self.work_dir.clean()
+
+    def _store_annotation_images(
+        self, ds: SpectralDataset, search: MSMBasicSearch, bundle: SearchResultsBundle
+    ) -> None:
+        """Persist ion images for annotations at FDR <= 0.5 (the reference
+        stores images for scored target ions — ``store_sf_iso_images`` [U])."""
+        import numpy as np
+
+        from ..ops.imager_np import SortedPeakView, extract_ion_images
+
+        table = search.last_table
+        if table is None or bundle.annotations.empty:
+            return
+        keep = bundle.annotations[bundle.annotations.fdr_level <= 0.5]
+        want = set(zip(keep.sf, keep.adduct))
+        idx = [
+            i for i, (sf, ad) in enumerate(zip(table.sfs, table.adducts))
+            if (sf, ad) in want
+        ]
+        if not idx:
+            return
+        sub = table.__class__(
+            sfs=[table.sfs[i] for i in idx],
+            adducts=[table.adducts[i] for i in idx],
+            mzs=table.mzs[idx],
+            ints=table.ints[idx],
+            n_valid=table.n_valid[idx],
+            targets=table.targets[idx],
+        )
+        view = SortedPeakView.prepare(ds)
+        images = extract_ion_images(view, sub, self.ds_config.image_generation.ppm)
+        path = self.store.store_ion_images(
+            self.ds_id, np.asarray(images),
+            list(zip(sub.sfs, sub.adducts)), ds.nrows, ds.ncols,
+        )
+        logger.info("stored %d ion image sets -> %s", len(idx), path)

@@ -1,0 +1,274 @@
+"""Result storage + job ledger + annotation index — the L0 state plane.
+
+TPU-native/offline replacements for the reference's service stack (SURVEY.md
+#2 ``db.py::DB`` Postgres, #14 ``search_results.py::SearchResults``, #15
+``es_export.py::ESExporter``, #21 SQL schema):
+
+- ``JobLedger``     — sqlite tables ``dataset`` / ``job`` with status rows
+  (STARTED/FINISHED/FAILED), the reference's job bookkeeping.
+- ``SearchResultsStore`` — per-job parquet files (annotations + all metrics)
+  plus sparse ion images as npz, the reference's ``iso_image_metrics`` /
+  ``iso_image`` tables.
+- ``AnnotationIndex`` — a searchable sqlite table of flattened annotations
+  (ds, sf, adduct, msm, fdr, mz), the reference's Elasticsearch index:
+  ``index_ds`` / ``delete_ds`` / ``search`` with the same flattening.
+
+Everything lives under ``StorageConfig.results_dir``; all writers are
+idempotent per (ds_id, job_id) so failed jobs can simply be re-run
+(SURVEY.md §5.3: idempotent re-run as the recovery model).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+from ..utils.logger import logger
+
+JOB_STARTED = "STARTED"
+JOB_FINISHED = "FINISHED"
+JOB_FAILED = "FAILED"
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS dataset (
+    id TEXT PRIMARY KEY,
+    name TEXT,
+    input_path TEXT,
+    ds_config TEXT,
+    created_at REAL
+);
+CREATE TABLE IF NOT EXISTS job (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    ds_id TEXT REFERENCES dataset(id),
+    status TEXT,
+    started_at REAL,
+    finished_at REAL,
+    error TEXT
+);
+CREATE TABLE IF NOT EXISTS annotation (
+    ds_id TEXT,
+    job_id INTEGER,
+    sf TEXT,
+    adduct TEXT,
+    mz REAL,
+    msm REAL,
+    fdr REAL,
+    fdr_level REAL,
+    chaos REAL,
+    spatial REAL,
+    spectral REAL
+);
+CREATE INDEX IF NOT EXISTS annotation_ds ON annotation(ds_id);
+CREATE INDEX IF NOT EXISTS annotation_sf ON annotation(sf);
+"""
+
+
+class JobLedger:
+    """Job/dataset status bookkeeping (reference: ``job``/``dataset`` rows in
+    Postgres written by SearchJob [U])."""
+
+    def __init__(self, results_dir: str | Path):
+        self.root = Path(results_dir)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.db_path = self.root / "engine.sqlite"
+        self._conn = sqlite3.connect(self.db_path)
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+
+    def upsert_dataset(self, ds_id: str, name: str, input_path: str,
+                       ds_config: dict) -> None:
+        self._conn.execute(
+            "INSERT INTO dataset(id, name, input_path, ds_config, created_at) "
+            "VALUES(?,?,?,?,?) ON CONFLICT(id) DO UPDATE SET "
+            "name=excluded.name, input_path=excluded.input_path, "
+            "ds_config=excluded.ds_config",
+            (ds_id, name, input_path, json.dumps(ds_config), time.time()),
+        )
+        self._conn.commit()
+
+    def start_job(self, ds_id: str) -> int:
+        cur = self._conn.execute(
+            "INSERT INTO job(ds_id, status, started_at) VALUES(?,?,?)",
+            (ds_id, JOB_STARTED, time.time()),
+        )
+        self._conn.commit()
+        return int(cur.lastrowid)
+
+    def finish_job(self, job_id: int) -> None:
+        self._conn.execute(
+            "UPDATE job SET status=?, finished_at=? WHERE id=?",
+            (JOB_FINISHED, time.time(), job_id),
+        )
+        self._conn.commit()
+
+    def fail_job(self, job_id: int, error: str) -> None:
+        self._conn.execute(
+            "UPDATE job SET status=?, finished_at=?, error=? WHERE id=?",
+            (JOB_FAILED, time.time(), error[:4000], job_id),
+        )
+        self._conn.commit()
+
+    def job_status(self, job_id: int) -> str | None:
+        row = self._conn.execute(
+            "SELECT status FROM job WHERE id=?", (job_id,)
+        ).fetchone()
+        return row[0] if row else None
+
+    def jobs(self, ds_id: str | None = None) -> pd.DataFrame:
+        q = "SELECT * FROM job"
+        args: tuple = ()
+        if ds_id is not None:
+            q += " WHERE ds_id=?"
+            args = (ds_id,)
+        return pd.read_sql_query(q + " ORDER BY id", self._conn, params=args)
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class AnnotationIndex:
+    """The Elasticsearch-equivalent searchable annotation index
+    (reference: ``ESExporter.index_ds/delete_ds`` [U], SURVEY.md #15)."""
+
+    def __init__(self, ledger: JobLedger):
+        self._conn = ledger._conn
+
+    def index_ds(self, ds_id: str, job_id: int, annotations: pd.DataFrame,
+                 ion_mzs: dict[tuple[str, str], float] | None = None) -> int:
+        """Flatten + index annotations; re-indexing a dataset replaces its
+        rows (idempotent, like delete+index in the reference)."""
+        self.delete_ds(ds_id)
+        rows = [
+            (
+                ds_id, job_id, r.sf, r.adduct,
+                float(ion_mzs.get((r.sf, r.adduct), np.nan)) if ion_mzs else np.nan,
+                float(r.msm), float(r.fdr), float(r.fdr_level),
+                float(r.chaos), float(r.spatial), float(r.spectral),
+            )
+            for r in annotations.itertuples()
+        ]
+        self._conn.executemany(
+            "INSERT INTO annotation VALUES(?,?,?,?,?,?,?,?,?,?,?)", rows
+        )
+        self._conn.commit()
+        return len(rows)
+
+    def delete_ds(self, ds_id: str, job_id: int | None = None) -> None:
+        """Drop a dataset's index rows; with ``job_id``, only that job's rows
+        (failure cleanup must not erase a previous successful job's index)."""
+        if job_id is None:
+            self._conn.execute("DELETE FROM annotation WHERE ds_id=?", (ds_id,))
+        else:
+            self._conn.execute(
+                "DELETE FROM annotation WHERE ds_id=? AND job_id=?", (ds_id, job_id)
+            )
+        self._conn.commit()
+
+    def search(
+        self,
+        ds_id: str | None = None,
+        sf: str | None = None,
+        adduct: str | None = None,
+        max_fdr_level: float | None = None,
+        min_msm: float | None = None,
+    ) -> pd.DataFrame:
+        clauses, args = [], []
+        for col, val in (("ds_id", ds_id), ("sf", sf), ("adduct", adduct)):
+            if val is not None:
+                clauses.append(f"{col}=?")
+                args.append(val)
+        if max_fdr_level is not None:
+            clauses.append("fdr_level<=?")
+            args.append(max_fdr_level)
+        if min_msm is not None:
+            clauses.append("msm>=?")
+            args.append(min_msm)
+        q = "SELECT * FROM annotation"
+        if clauses:
+            q += " WHERE " + " AND ".join(clauses)
+        return pd.read_sql_query(q + " ORDER BY msm DESC", self._conn, params=args)
+
+
+class SearchResultsStore:
+    """Persist a finished search (reference: ``SearchResults.store`` →
+    ``iso_image_metrics`` + ``iso_image`` + ES trigger [U], SURVEY.md #14)."""
+
+    def __init__(self, ledger: JobLedger, store_images: bool = True,
+                 image_format: str = "npz"):
+        self.ledger = ledger
+        self.index = AnnotationIndex(ledger)
+        self.store_images = store_images
+        self.image_format = image_format
+
+    def ds_dir(self, ds_id: str) -> Path:
+        d = self.ledger.root / ds_id
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def store(self, ds_id: str, job_id: int, bundle,
+              ion_mzs: dict[tuple[str, str], float] | None = None) -> Path:
+        """Write annotations + metrics parquet, index annotations. Returns the
+        dataset results dir."""
+        d = self.ds_dir(ds_id)
+        bundle.annotations.to_parquet(d / "annotations.parquet", index=False)
+        bundle.all_metrics.to_parquet(d / "all_metrics.parquet", index=False)
+        (d / "timings.json").write_text(json.dumps(bundle.timings, indent=2))
+        n = self.index.index_ds(ds_id, job_id, bundle.annotations, ion_mzs)
+        logger.info("stored %d annotations for ds %s under %s", n, ds_id, d)
+        return d
+
+    def store_ion_images(
+        self,
+        ds_id: str,
+        images: np.ndarray,          # (n_ions, max_peaks, n_pix) dense
+        ions: list[tuple[str, str]],
+        nrows: int,
+        ncols: int,
+    ) -> Path:
+        """Sparse-store ion images (reference keeps scipy CSR blobs in the
+        ``iso_image`` table [U]; dense tiles live on TPU, sparsity only at
+        host egress — SURVEY.md §2c)."""
+        d = self.ds_dir(ds_id)
+        if self.image_format == "png":
+            from .png import PngGenerator
+
+            gen = PngGenerator()
+            img_dir = d / "ion_images"
+            img_dir.mkdir(exist_ok=True)
+            for (sf, adduct), ion_imgs in zip(ions, images):
+                name = f"{sf}{adduct}".replace("+", "p").replace("-", "m")
+                gen.save(ion_imgs[0].reshape(nrows, ncols), img_dir / f"{name}.png")
+            return img_dir
+        flat = images.reshape(images.shape[0] * images.shape[1], -1)
+        nz = flat != 0
+        counts = nz.sum(axis=1)
+        indptr = np.zeros(flat.shape[0] + 1, dtype=np.int64)
+        np.cumsum(counts, out=indptr[1:])
+        cols = np.nonzero(nz)[1].astype(np.int32)
+        vals = flat[nz].astype(np.float32)
+        np.savez_compressed(
+            d / "ion_images.npz",
+            data=vals, indices=cols, indptr=indptr,
+            shape=np.array([images.shape[0], images.shape[1], nrows, ncols]),
+            ions=np.array([f"{sf}|{adduct}" for sf, adduct in ions]),
+        )
+        return d / "ion_images.npz"
+
+    @staticmethod
+    def load_ion_images(path: str | Path) -> tuple[np.ndarray, list[tuple[str, str]]]:
+        """Inverse of ``store_ion_images`` (npz format): dense (n_ions, K,
+        nrows, ncols) + ion list."""
+        z = np.load(path, allow_pickle=False)
+        n_ions, k, nrows, ncols = (int(x) for x in z["shape"])
+        flat = np.zeros((n_ions * k, nrows * ncols), dtype=np.float32)
+        indptr = z["indptr"]
+        for r in range(flat.shape[0]):
+            s, e = indptr[r], indptr[r + 1]
+            flat[r, z["indices"][s:e]] = z["data"][s:e]
+        ions = [tuple(s.split("|", 1)) for s in z["ions"].tolist()]
+        return flat.reshape(n_ions, k, nrows, ncols), ions

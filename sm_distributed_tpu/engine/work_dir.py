@@ -1,0 +1,89 @@
+"""Work-dir staging with existence-check resume.
+
+Reference: ``sm/engine/work_dir.py::WorkDirManager`` [U] (SURVEY.md #3) stages
+input data on local FS or S3 and skips finished stages when their outputs
+already exist (the reference's poor-man's resume, SURVEY.md §5.4).  Here:
+local staging only (no S3 in scope offline), same skip-if-present semantics,
+plus a manifest recording the input fingerprint so a changed input busts the
+stale staging.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+
+from ..utils.logger import logger
+
+
+class WorkDirManager:
+    """Per-dataset scratch dir: ``<work_root>/<ds_id>/``."""
+
+    def __init__(self, work_root: str | Path, ds_id: str):
+        self.path = Path(work_root) / ds_id
+        self.path.mkdir(parents=True, exist_ok=True)
+
+    def _fingerprint(self, src: Path) -> dict:
+        if src.is_file():
+            return {src.name: [src.stat().st_size, int(src.stat().st_mtime)]}
+        files = sorted(p for p in src.rglob("*") if p.is_file())
+        return {
+            str(p.relative_to(src)): [p.stat().st_size, int(p.stat().st_mtime)]
+            for p in files
+        }
+
+    def copy_input_data(self, input_path: str | Path) -> Path:
+        """Stage input (an imzML file or a directory holding the imzML/ibd
+        pair) into the work dir; skip if already staged and unchanged."""
+        src = Path(input_path)
+        if not src.exists():
+            raise FileNotFoundError(f"input path does not exist: {src}")
+        dst = self.path / "input"
+        manifest = self.path / "input.manifest.json"
+        fp = self._fingerprint(src)
+        if dst.exists() and manifest.exists():
+            try:
+                if json.loads(manifest.read_text()) == fp:
+                    logger.info("work_dir: input already staged at %s, skipping", dst)
+                    return dst
+            except json.JSONDecodeError:
+                pass
+        if dst.exists():
+            shutil.rmtree(dst)
+        dst.mkdir(parents=True)
+        if src.is_file():
+            shutil.copy2(src, dst / src.name)
+            ibd = src.with_suffix(".ibd")
+            if ibd.exists():
+                shutil.copy2(ibd, dst / ibd.name)
+        else:
+            # preserve relative layout — basename flattening would silently
+            # overwrite same-named files from different subdirs
+            for p in src.rglob("*"):
+                if p.is_file():
+                    out = dst / p.relative_to(src)
+                    out.parent.mkdir(parents=True, exist_ok=True)
+                    shutil.copy2(p, out)
+        manifest.write_text(json.dumps(fp))
+        logger.info("work_dir: staged %s -> %s", src, dst)
+        return dst
+
+    def imzml_path(self) -> Path:
+        root = self.path / "input"
+        hits = sorted(root.rglob("*.imzML")) or sorted(root.rglob("*.imzml"))
+        if not hits:
+            raise FileNotFoundError(f"no .imzML file staged under {root}")
+        return hits[0]
+
+    def exists(self, name: str) -> bool:
+        return (self.path / name).exists()
+
+    def file(self, name: str) -> Path:
+        return self.path / name
+
+    def clean(self) -> None:
+        """Remove the whole per-dataset scratch dir (reference: WorkDir.clean [U])."""
+        if self.path.exists():
+            shutil.rmtree(self.path)
+            logger.info("work_dir: cleaned %s", self.path)

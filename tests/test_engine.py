@@ -1,0 +1,246 @@
+"""Engine-layer tests: ledger, result store, annotation index, work dir,
+mol DB, queue daemon, SearchJob, CLI — mirroring the reference's
+DB-integration + end-to-end test tier (SURVEY.md §4) against the local
+sqlite/parquet/file-queue stand-ins."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sm_distributed_tpu.engine.daemon import (
+    QueueConsumer,
+    QueuePublisher,
+    annotate_callback,
+)
+from sm_distributed_tpu.engine.moldb import MolecularDB
+from sm_distributed_tpu.engine.search_job import SearchJob
+from sm_distributed_tpu.engine.storage import (
+    AnnotationIndex,
+    JobLedger,
+    SearchResultsStore,
+)
+from sm_distributed_tpu.engine.work_dir import WorkDirManager
+from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+from sm_distributed_tpu.models.msm_basic import SearchResultsBundle
+from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+
+@pytest.fixture(scope="module")
+def fixture_path(tmp_path_factory):
+    out = tmp_path_factory.mktemp("dse")
+    path, truth = generate_synthetic_dataset(
+        out, nrows=8, ncols=8, formulas=None, present_fraction=0.5,
+        noise_peaks=40, seed=5,
+    )
+    return path, truth
+
+
+def _ann_df():
+    return pd.DataFrame({
+        "sf": ["C6H12O6", "C5H5N5"],
+        "adduct": ["+H", "+H"],
+        "msm": [0.9, 0.4],
+        "fdr": [0.01, 0.3],
+        "fdr_level": [0.05, 0.5],
+        "chaos": [0.95, 0.6],
+        "spatial": [0.97, 0.7],
+        "spectral": [0.98, 0.95],
+    })
+
+
+def test_ledger_job_lifecycle(tmp_path):
+    ledger = JobLedger(tmp_path / "res")
+    ledger.upsert_dataset("ds1", "my ds", "/in", {"k": 1})
+    job = ledger.start_job("ds1")
+    assert ledger.job_status(job) == "STARTED"
+    ledger.finish_job(job)
+    assert ledger.job_status(job) == "FINISHED"
+    job2 = ledger.start_job("ds1")
+    ledger.fail_job(job2, "boom")
+    jobs = ledger.jobs("ds1")
+    assert list(jobs.status) == ["FINISHED", "FAILED"]
+    assert "boom" in jobs.error.iloc[1]
+
+
+def test_annotation_index_roundtrip_and_job_scoped_delete(tmp_path):
+    ledger = JobLedger(tmp_path / "res")
+    index = AnnotationIndex(ledger)
+    n = index.index_ds("ds1", 1, _ann_df(), ion_mzs={("C6H12O6", "+H"): 181.07})
+    assert n == 2
+    hits = index.search(ds_id="ds1", max_fdr_level=0.1)
+    assert list(hits.sf) == ["C6H12O6"]
+    assert hits.mz.iloc[0] == pytest.approx(181.07)
+    # job-scoped delete must not erase other jobs' rows
+    index._conn.execute(
+        "INSERT INTO annotation VALUES('ds1',2,'X','+H',1,0.5,0.1,0.2,0.5,0.5,0.5)"
+    )
+    index.delete_ds("ds1", job_id=2)
+    assert len(index.search(ds_id="ds1")) == 2
+    index.delete_ds("ds1")
+    assert index.search(ds_id="ds1").empty
+
+
+def test_results_store_parquet_and_images(tmp_path):
+    ledger = JobLedger(tmp_path / "res")
+    store = SearchResultsStore(ledger)
+    bundle = SearchResultsBundle(
+        annotations=_ann_df(),
+        all_metrics=_ann_df()[["sf", "adduct", "chaos", "spatial", "spectral", "msm"]],
+        timings={"score": 1.0},
+    )
+    d = store.store("ds1", 1, bundle)
+    assert (d / "annotations.parquet").exists()
+    back = pd.read_parquet(d / "annotations.parquet")
+    assert list(back.sf) == ["C6H12O6", "C5H5N5"]
+    # sparse npz round-trip
+    rng = np.random.default_rng(0)
+    imgs = rng.random((2, 4, 12)).astype(np.float32)
+    imgs[imgs < 0.5] = 0.0
+    path = store.store_ion_images("ds1", imgs, [("A", "+H"), ("B", "+Na")], 3, 4)
+    dense, ions = SearchResultsStore.load_ion_images(path)
+    assert ions == [("A", "+H"), ("B", "+Na")]
+    np.testing.assert_allclose(dense.reshape(2, 4, 12), imgs)
+
+
+def test_work_dir_staging_resume_and_subdirs(tmp_path):
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "a.imzML").write_text("x")
+    (src / "sub" / "a.imzML").write_text("y")  # same basename, different subdir
+    wd = WorkDirManager(tmp_path / "work", "ds1")
+    dst = wd.copy_input_data(src)
+    assert (dst / "a.imzML").read_text() == "x"
+    assert (dst / "sub" / "a.imzML").read_text() == "y"
+    # unchanged input -> staging skipped (manifest hit): mutate dst marker
+    marker = dst / "marker"
+    marker.write_text("m")
+    assert wd.copy_input_data(src) == dst
+    assert marker.exists(), "unchanged input must not re-stage"
+    # changed input -> re-staged, marker gone
+    (src / "a.imzML").write_text("xx")
+    wd.copy_input_data(src)
+    assert not marker.exists()
+    assert wd.imzml_path().name == "a.imzML"
+    wd.clean()
+    assert not wd.path.exists()
+
+
+def test_moldb_import_and_lookup(tmp_path):
+    csv = tmp_path / "db.csv"
+    csv.write_text("id,name,formula\n1,Glucose,C6H12O6\n2,Dup,C6H12O6\n3,Adenine,C5H5N5\n")
+    db = MolecularDB(JobLedger(tmp_path / "res"))
+    assert db.import_csv(csv, "HMDB", "v1") == 3
+    assert db.formulas("HMDB", "v1") == ["C6H12O6", "C5H5N5"]  # deduped, ordered
+    assert db.databases() == [("HMDB", "v1")]
+    # re-import replaces
+    csv.write_text("sf\nC16H32O2\n")
+    assert db.import_csv(csv, "HMDB", "v1") == 1
+    assert db.formulas("HMDB") == ["C16H32O2"]
+    with pytest.raises(KeyError):
+        db.formulas("nope")
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.csv"
+        bad.write_text("x,y\n1,2\n")
+        db.import_csv(bad, "B", "1")
+
+
+def test_search_job_end_to_end_and_failure(fixture_path, tmp_path):
+    path, truth = fixture_path
+    sm = SMConfig.from_dict({
+        "backend": "numpy_ref",
+        "fdr": {"decoy_sample_size": 3, "seed": 2},
+        "storage": {"results_dir": str(tmp_path / "res")},
+        "work_dir": str(tmp_path / "work"),
+    })
+    ds_config = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    formulas = truth.formulas[:8]
+    job = SearchJob("dsE", "e2e", path, ds_config, sm, formulas=formulas)
+    bundle = job.run()
+    assert len(bundle.annotations) == 8
+    ledger = JobLedger(tmp_path / "res")
+    assert (ledger.jobs("dsE").status == "FINISHED").all()
+    index = AnnotationIndex(ledger)
+    ok_rows = index.search(ds_id="dsE")
+    assert len(ok_rows) == 8 and ok_rows.mz.notna().all()
+    # failed second job must not wipe the first job's index rows
+    bad = SearchJob("dsE", "e2e", tmp_path / "missing.imzML", ds_config, sm,
+                    formulas=formulas)
+    with pytest.raises(FileNotFoundError):
+        bad.run()
+    jobs = ledger.jobs("dsE")
+    assert list(jobs.status) == ["FINISHED", "FAILED"]
+    assert len(AnnotationIndex(ledger).search(ds_id="dsE")) == 8
+
+
+def test_daemon_queue_success_failure_poison(fixture_path, tmp_path):
+    path, truth = fixture_path
+    sm = SMConfig.from_dict({
+        "backend": "numpy_ref",
+        "fdr": {"decoy_sample_size": 2, "seed": 1},
+        "storage": {"results_dir": str(tmp_path / "res")},
+        "work_dir": str(tmp_path / "work"),
+    })
+    pub = QueuePublisher(tmp_path / "q")
+    pub.publish({"ds_id": "q1", "input_path": str(path),
+                 "formulas": truth.formulas[:3],
+                 "ds_config": {"isotope_generation": {"adducts": ["+H"]}}})
+    pub.publish({"ds_id": "q2", "input_path": "/nope.imzML"})
+    # poison message: invalid JSON dropped into pending by a foreign producer
+    (tmp_path / "q" / "sm_annotate" / "pending" / "zz_poison.json").write_text("{broken")
+    consumer = QueueConsumer(tmp_path / "q", annotate_callback(sm))
+    consumer.run(max_messages=3)
+    root = tmp_path / "q" / "sm_annotate"
+    assert len(list(root.glob("done/*.json"))) == 1
+    assert len(list(root.glob("failed/*.json"))) == 2
+    assert not list(root.glob("pending/*.json"))
+    # requeue_stale moves crashed messages back
+    (root / "running" / "stuck.json").write_text(json.dumps({"ds_id": "s"}))
+    assert consumer.requeue_stale() == 1
+    assert (root / "pending" / "stuck.json").exists()
+
+
+def test_cli_import_run_search(fixture_path, tmp_path, capsys):
+    from sm_distributed_tpu.engine.cli import main
+
+    path, truth = fixture_path
+    sm_json = tmp_path / "sm.json"
+    sm_json.write_text(json.dumps({
+        "backend": "numpy_ref",
+        "fdr": {"decoy_sample_size": 2, "seed": 1},
+        "storage": {"results_dir": str(tmp_path / "res")},
+        "work_dir": str(tmp_path / "work"),
+    }))
+    ds_json = tmp_path / "ds.json"
+    ds_json.write_text(json.dumps({
+        "database": {"name": "mini", "version": "t"},
+        "isotope_generation": {"adducts": ["+H"]},
+    }))
+    csv = tmp_path / "mini.csv"
+    csv.write_text("formula\n" + "\n".join(truth.formulas[:4]) + "\n")
+    assert main(["import-db", str(csv), "mini", "t", "--sm-config", str(sm_json)]) == 0
+    assert main(["run", "cli ds", str(path), "--ds-id", "cli1",
+                 "--ds-config", str(ds_json), "--sm-config", str(sm_json)]) == 0
+    assert main(["search", "--ds-id", "cli1", "--sm-config", str(sm_json)]) == 0
+    out = capsys.readouterr().out
+    assert any(sf in out for sf in truth.formulas[:4])
+
+
+def test_png_generator(tmp_path):
+    from sm_distributed_tpu.engine.png import PngGenerator
+
+    img = np.zeros((8, 10))
+    img[2:5, 3:7] = np.arange(12).reshape(3, 4)
+    mask = img > -1
+    mask[0, 0] = False
+    gen = PngGenerator(mask=mask)
+    data = gen.render(img)
+    assert data[:8] == b"\x89PNG\r\n\x1a\n"
+    p = gen.save(img, tmp_path / "ion.png")
+    from PIL import Image
+
+    arr = np.asarray(Image.open(p))
+    assert arr.shape == (8, 10, 4)
+    assert arr[0, 0, 3] == 0          # masked pixel transparent
+    assert arr[3, 4, 3] == 255
