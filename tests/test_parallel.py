@@ -125,3 +125,19 @@ def test_sharded_batch_divisibility(fixture_ds):
     out = backend.score_batch(_table(truth, n=6))
     assert out.shape == (6, 4)
     assert np.isfinite(out).all()
+
+
+def test_dryrun_multichip_driver_path():
+    """The driver-facing entry: must force its own virtual CPU mesh in a
+    fresh subprocess (VERDICT round-1 item 1) and exit 0 even when the
+    calling process has a different platform configured."""
+    import os
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    try:
+        from __graft_entry__ import dryrun_multichip
+    finally:
+        sys.path.remove(repo_root)
+    dryrun_multichip(4)
