@@ -1,0 +1,116 @@
+"""Per-phase timing breakdown of the fused graph on the bench workload.
+
+Times each stage (extraction, chaos, correlation, pattern match) as its own
+jitted function with block_until_ready, on the same synthetic dataset and
+batch shapes bench.py uses.  Run on the real chip to attribute cost before
+optimizing (VERDICT round-1 item 2).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sm_distributed_tpu.io.dataset import SpectralDataset
+from sm_distributed_tpu.io.fixtures import FIXTURE_FORMULAS, generate_synthetic_dataset
+from sm_distributed_tpu.models.msm_jax import JaxBackend
+from sm_distributed_tpu.models.msm_basic import _slice_table
+from sm_distributed_tpu.ops.fdr import FDR
+from sm_distributed_tpu.ops.imager_jax import extract_images, window_rank_grid
+from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+from sm_distributed_tpu.ops.metrics_jax import (
+    isotope_image_correlation_batch,
+    isotope_pattern_match_batch,
+    measure_of_chaos_batch,
+)
+from sm_distributed_tpu.ops.quantize import quantize_window
+from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+from sm_distributed_tpu.utils.logger import init_logger, logger
+
+
+def timeit(name, fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    logger.info("%-28s %8.2f ms", name, dt * 1e3)
+    return out, dt
+
+
+def main():
+    init_logger()
+    cache_dir = Path(__file__).parent.parent / ".cache"
+    path, truth = generate_synthetic_dataset(
+        cache_dir / "bench_ds", nrows=64, ncols=64,
+        formulas=FIXTURE_FORMULAS, present_fraction=0.6, noise_peaks=200, seed=7,
+    )
+    ds = SpectralDataset.from_imzml(path)
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]}, "image_generation": {"ppm": 3.0}}
+    )
+    sm_config = SMConfig.from_dict(
+        {"backend": "jax_tpu", "fdr": {"decoy_sample_size": 20},
+         "parallel": {"formula_batch": 512}}
+    )
+
+    fdr = FDR(decoy_sample_size=20, target_adducts=("+H",), seed=42)
+    assignment = fdr.decoy_adduct_selection(truth.formulas)
+    pairs, flags = assignment.all_ion_tuples(truth.formulas, ("+H",))
+    calc = IsocalcWrapper(ds_config.isotope_generation, cache_dir=str(cache_dir / "isocalc"))
+    table = calc.pattern_table(pairs, flags)
+
+    backend = JaxBackend(ds, ds_config, sm_config)
+    b = sm_config.parallel.formula_batch
+    sub = _slice_table(table, 0, min(b, table.n_ions))
+    n, k = sub.n_ions, sub.max_peaks
+
+    lo_q, hi_q = quantize_window(sub.mzs, ds_config.image_generation.ppm)
+    lo_p = np.zeros((b, k), np.int32); hi_p = np.zeros((b, k), np.int32)
+    ints_p = np.zeros((b, k), np.float32); nv_p = np.zeros(b, np.int32)
+    lo_p[:n], hi_p[:n] = lo_q, hi_q
+    ints_p[:n] = sub.ints; nv_p[:n] = sub.n_valid
+    grid, r_lo, r_hi = window_rank_grid(lo_p, hi_p)
+    logger.info("batch=%d ions, k=%d, grid=%d bins, cube=%s",
+                b, k, grid.shape[0], backend._mz_q.shape)
+
+    grid_d = jax.device_put(grid)
+    r_lo_d = jax.device_put(r_lo); r_hi_d = jax.device_put(r_hi)
+    ints_d = jax.device_put(ints_p); nv_d = jax.device_put(nv_p)
+
+    # full fused graph
+    _, t_full = timeit("fused full", backend._fn, backend._mz_q, backend._ints,
+                       grid_d, r_lo_d.reshape(b, k), r_hi_d.reshape(b, k),
+                       ints_d, nv_d)
+
+    # extraction only
+    ext = jax.jit(extract_images)
+    imgs_flat, t_ext = timeit("extract_images", ext, backend._mz_q, backend._ints,
+                              grid_d, r_lo_d, r_hi_d)
+    imgs = imgs_flat.reshape(b, k, -1)[:, :, : ds.nrows * ds.ncols]
+    imgs = jax.device_put(np.asarray(imgs))
+    valid = np.arange(k)[None, :] < nv_p[:, None]
+    valid_d = jax.device_put(valid)
+
+    chaos_fn = jax.jit(partial(measure_of_chaos_batch, nrows=ds.nrows, ncols=ds.ncols))
+    _, t_chaos = timeit("chaos (30 levels)", chaos_fn, imgs[:, 0, :])
+
+    corr_fn = jax.jit(isotope_image_correlation_batch)
+    _, t_corr = timeit("correlation", corr_fn, imgs, ints_d, valid_d)
+
+    pat_fn = jax.jit(lambda im, th, v: isotope_pattern_match_batch(im.sum(-1), th, v))
+    _, t_pat = timeit("pattern match", pat_fn, imgs, ints_d, valid_d)
+
+    logger.info("sum of parts: %.2f ms (full %.2f ms)",
+                (t_ext + t_chaos + t_corr + t_pat) * 1e3, t_full * 1e3)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,29 @@ FIXTURE_FORMULAS: list[str] = [
 ]
 
 
+def expand_formula_list(n: int) -> list[str]:
+    """Deterministic list of ``n`` plausible CHNO sum formulas for scale
+    benchmarks (BASELINE configs #2/#3 need thousands of ions; the bundled
+    50-formula fixture alone underfills a 1024-ion batch)."""
+    out = list(dict.fromkeys(FIXTURE_FORMULAS))
+    c, h_off, nn, o = 7, 0, 0, 2
+    while len(out) < n:
+        h = c + 2 - h_off % 5 + nn
+        sf = f"C{c}H{max(2, h)}" + (f"N{nn}" if nn else "") + (f"O{o}" if o else "")
+        if sf not in out:
+            out.append(sf)
+        # walk composition space deterministically
+        c += 1
+        if c > 40:
+            c = 7
+            o += 1
+            if o > 12:
+                o = 0
+                nn += 1
+            h_off += 1
+    return out[:n]
+
+
 @dataclass
 class SyntheticGroundTruth:
     formulas: list[str]          # all target formulas written to the mol DB
@@ -71,20 +94,49 @@ def generate_synthetic_dataset(
     mz_jitter_ppm: float = 0.5,
     seed: int = 7,
     name: str = "synthetic_spheroid",
+    reuse: bool = False,
 ) -> tuple[Path, SyntheticGroundTruth]:
     """Write a processed-mode imzML/ibd pair with known ground truth.
 
     Returns (imzml_path, ground_truth).  ``present_fraction`` of the formulas
     receive structured spatial signal at their theoretical isotope m/z values
     (intensities following the theoretical envelope); everything else only
-    ever matches background noise.
+    ever matches background noise.  With ``reuse=True`` an existing output is
+    kept when a parameter-marker file matches (generation is deterministic in
+    ``seed``, so the ground truth can be rebuilt without rewriting spectra).
     """
+    import json
+
     out_dir = Path(out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     rng = np.random.default_rng(seed)
     formulas = list(formulas if formulas is not None else FIXTURE_FORMULAS)
     iso_cfg = iso_cfg or IsotopeGenerationConfig(adducts=(adduct,))
     calc = IsocalcWrapper(iso_cfg)
+
+    marker = out_dir / f"{name}.params.json"
+    params = {
+        "nrows": nrows, "ncols": ncols, "formulas": formulas,
+        "present_fraction": present_fraction, "adduct": adduct,
+        "noise_peaks": noise_peaks, "mz_jitter_ppm": mz_jitter_ppm,
+        "seed": seed, "iso": [list(iso_cfg.adducts), iso_cfg.charge,
+                              iso_cfg.isocalc_sigma, iso_cfg.isocalc_pts_per_mz],
+    }
+    imzml_path = out_dir / f"{name}.imzML"
+    ibd_path = imzml_path.with_suffix(".ibd")
+    if reuse and marker.exists() and imzml_path.exists() and ibd_path.exists():
+        try:
+            if json.loads(marker.read_text()) == params:
+                n_present = max(1, int(round(present_fraction * len(formulas))))
+                present = list(rng.permutation(formulas)[:n_present])
+                return imzml_path, SyntheticGroundTruth(
+                    formulas=formulas, present=present, adduct=adduct,
+                    nrows=nrows, ncols=ncols)
+        except (json.JSONDecodeError, OSError):
+            pass
+    # invalidate before regenerating: a killed run must not leave a marker
+    # that validates partially-written files on the next reuse=True call
+    marker.unlink(missing_ok=True)
 
     n_present = max(1, int(round(present_fraction * len(formulas))))
     present = list(rng.permutation(formulas)[:n_present])
@@ -99,7 +151,6 @@ def generate_synthetic_dataset(
         images[sf] = _spatial_pattern(i, nrows, ncols, rng)
 
     mz_lo, mz_hi = 80.0, 1000.0
-    imzml_path = out_dir / f"{name}.imzML"
     with ImzMLWriter(imzml_path, continuous=False) as wr:
         for y in range(nrows):
             for x in range(ncols):
@@ -126,4 +177,5 @@ def generate_synthetic_dataset(
     truth = SyntheticGroundTruth(
         formulas=formulas, present=present, adduct=adduct, nrows=nrows, ncols=ncols
     )
+    marker.write_text(json.dumps(params))
     return imzml_path, truth

@@ -45,6 +45,11 @@ class NumpyBackend:
         self.ds_config = ds_config
         self._view = SortedPeakView.prepare(ds)  # sort once, reuse per batch
 
+    def score_batches(self, tables) -> list[np.ndarray]:
+        """Score an iterable of batches one at a time (no pipelining on CPU;
+        accepts a lazy generator so only one slice is live at once)."""
+        return [self.score_batch(t) for t in tables]
+
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         """(n_ions, 4) array of (chaos, spatial, spectral, msm)."""
         img_cfg = self.ds_config.image_generation
@@ -142,9 +147,15 @@ class MSMBasicSearch:
         batch = max(1, self.sm_config.parallel.formula_batch)
         metrics = np.zeros((table.n_ions, 4))
         with phase_timer("score", timings):
-            for s in range(0, table.n_ions, batch):
-                e = min(s + batch, table.n_ions)
-                metrics[s:e] = backend.score_batch(_slice_table(table, s, e))
+            slices = [(s, min(s + batch, table.n_ions))
+                      for s in range(0, table.n_ions, batch)]
+            # lazy slices: every backend exposes score_batches; the jax one
+            # pipelines (async-enqueues all batches before syncing any), the
+            # numpy one consumes one slice at a time
+            outs = backend.score_batches(
+                _slice_table(table, s, e) for s, e in slices)
+            for (s, e), out in zip(slices, outs):
+                metrics[s:e] = out
         with phase_timer("fdr", timings):
             all_df = pd.DataFrame(
                 {

@@ -1,0 +1,172 @@
+"""measure_of_chaos connected components — Pallas TPU kernel.
+
+The round-1 implementation (ops/metrics_jax.py) runs the min-label flood as
+``lax.associative_scan`` sweeps over the WHOLE formula batch inside one
+``lax.while_loop``: every sweep round-trips (batch, nrows, ncols) labels
+through HBM and the loop iterates until the *worst* image in the batch
+converges.  A profile of the 512-ion bench batch put ~113 ms of the ~190 ms
+batch in these whiles (VERDICT r1 "what's weak" #1).
+
+This kernel keeps the same exact algorithm — min-label flooding by
+segmented min-scans, fixpoint detection, count = #pixels whose final label
+equals their own index, bit-equal to ``scipy.ndimage.label`` — but runs it
+entirely in VMEM with convergence tracked per PROGRAM (a handful of images),
+not per batch:
+
+- Layout: images side by side along the lane axis — block (R, IB*C) where
+  IB*C is a multiple of 128.  Label floods never cross image boundaries
+  because the row-scan "open" flags are seeded with a boundary guard
+  (``col % C != 0`` forward, ``!= C-1`` backward).
+- All ``nlevels`` thresholds are processed inside the kernel (fori over
+  levels); per level a ``lax.while_loop`` sweeps to the exact fixpoint of
+  the IB images only — empty decoy images exit after one sweep instead of
+  riding the batch worst case.
+- Segmented min-scan = Hillis–Steele distance doubling with an int32
+  "open" flag (TPU cannot rotate i1 vectors): after step d, ``open[i]``
+  means "window (i-d, i] is fully masked and crosses no image boundary".
+- HBM traffic: each image is read ONCE (f32) and one count row is written —
+  everything else (labels, flags, masks) lives in registers/VMEM.
+
+Reference semantics: ``pyImagingMSpec.measure_of_chaos`` per-level component
+counts [U] (SURVEY.md #11); oracle: ops/metrics_np.py::measure_of_chaos.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BIG = np.int32(2**30)
+
+
+def _shift(x: jnp.ndarray, d: int, axis: int, reverse: bool, fill) -> jnp.ndarray:
+    """Non-circular shift by static d (fill at the exposed edge)."""
+    n = x.shape[axis]
+    rolled = pltpu.roll(x, (n - d) if reverse else d, axis=axis)
+    idx = lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    keep = (idx < n - d) if reverse else (idx >= d)
+    return jnp.where(keep, rolled, fill)
+
+
+def _seg_min_scan(v: jnp.ndarray, o: jnp.ndarray, axis: int, reverse: bool) -> jnp.ndarray:
+    """Segmented prefix-min along ``axis`` (Hillis–Steele): o[i]=1 iff the
+    pull window behind i is fully open (masked, no image boundary)."""
+    d = 1
+    n = v.shape[axis]
+    while d < n:
+        vs = _shift(v, d, axis, reverse, _BIG)
+        os_ = _shift(o, d, axis, reverse, np.int32(0))
+        v = jnp.minimum(v, jnp.where(o > 0, vs, _BIG))
+        o = o * os_
+        d *= 2
+    return v
+
+
+def _chaos_kernel(img_ref, vmax_ref, out_ref, *, ncols: int, nlevels: int):
+    """One program: IB images of shape (R, ncols) packed as (R, IB*ncols)."""
+    img = img_ref[:]                                   # (R, IBC) f32
+    shape = img.shape
+    row = lax.broadcasted_iota(jnp.int32, shape, 0)
+    col = lax.broadcasted_iota(jnp.int32, shape, 1)
+    incol = col % ncols                                # column within image
+    iota = row * ncols + incol                         # per-image pixel id
+    vmax = vmax_ref[:]                                 # (1, IBC) f32, per-lane
+
+    def level_body(li, acc):
+        # threshold grid identical to the oracle: vmax * li/nlevels,
+        # f32 arithmetic (li/nlevels rounds exactly as arange/nlevels)
+        thr = vmax * (li.astype(jnp.float32) / np.float32(nlevels))
+        mask = img > thr
+        mi = mask.astype(jnp.int32)
+        o_fwd = mi * (incol != 0)
+        o_bwd = mi * (incol != ncols - 1)
+        lab0 = jnp.where(mask, iota, _BIG)
+
+        def sweep(lab):
+            lab = _seg_min_scan(lab, o_fwd, 1, False)
+            lab = _seg_min_scan(lab, o_bwd, 1, True)
+            lab = _seg_min_scan(lab, mi, 0, False)
+            lab = _seg_min_scan(lab, mi, 0, True)
+            return jnp.where(mask, lab, _BIG)
+
+        def cond(st):
+            lab, prev = st
+            return jnp.any(lab != prev)
+
+        def body(st):
+            lab, _ = st
+            return sweep(lab), lab
+
+        lab, _ = lax.while_loop(cond, body, (sweep(lab0), lab0))
+        cnt = jnp.sum(((lab == iota) & mask).astype(jnp.int32), axis=0,
+                      keepdims=True)                   # (1, IBC) per-lane
+        return acc + cnt
+
+    acc = jnp.zeros((1, shape[1]), jnp.int32)
+    out_ref[:] = lax.fori_loop(0, nlevels, level_body, acc)
+
+
+def _pack_geometry(nrows: int, ncols: int, lane_width: int) -> tuple[int, int, int]:
+    """(R_pad, C_pad, IB): pad cols so IB*C_pad == lane block width."""
+    rp = -(-nrows // 8) * 8
+    if ncols <= lane_width:
+        cp = ncols
+        # smallest power-of-two-ish divisor layout: pad cols up until it
+        # divides the lane width
+        while lane_width % cp != 0:
+            cp += 1
+        ib = lane_width // cp
+    else:
+        cp = -(-ncols // lane_width) * lane_width
+        ib = 1
+    return rp, cp, ib
+
+
+@functools.partial(jax.jit, static_argnames=("nrows", "ncols", "nlevels", "lane_width", "interpret"))
+def chaos_count_sums(
+    principal: jnp.ndarray,   # (N, n_pix) f32, n_pix == nrows*ncols
+    *,
+    nrows: int,
+    ncols: int,
+    nlevels: int = 30,
+    lane_width: int = 512,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """(N,) f32: per-image SUM over levels of connected-component counts.
+
+    chaos = 1 - (sum/nlevels)/n_notnull is applied by the caller (exact: the
+    sums are small integers, f32-representable).
+    """
+    n = principal.shape[0]
+    rp, cp, ib = _pack_geometry(nrows, ncols, lane_width)
+    n_pad = -(-n // ib) * ib
+    img = jnp.zeros((n_pad, rp, cp), jnp.float32)
+    img = img.at[:n, :nrows, :ncols].set(
+        jnp.maximum(principal.reshape(n, nrows, ncols), 0.0))
+    vmax = img.max(axis=(1, 2))                        # (n_pad,)
+
+    # lanes-of-images layout: (R, n_pad*C); image i occupies lanes [i*C,(i+1)*C)
+    img_l = img.transpose(1, 0, 2).reshape(rp, n_pad * cp)
+    vmax_l = jnp.repeat(vmax, cp).reshape(1, n_pad * cp)
+
+    grid = (n_pad // ib,)
+    ibc = ib * cp
+    counts = pl.pallas_call(
+        functools.partial(_chaos_kernel, ncols=cp, nlevels=nlevels),
+        out_shape=jax.ShapeDtypeStruct((1, n_pad * cp), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((rp, ibc), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, ibc), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, ibc), lambda i: (0, i), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(img_l, vmax_l)
+    # per-image count sum: reduce each image's cp lanes
+    return counts.reshape(n_pad, cp).sum(axis=1)[:n].astype(jnp.float32)

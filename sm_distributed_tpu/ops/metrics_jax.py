@@ -77,23 +77,39 @@ def measure_of_chaos_batch(
     nrows: int,
     ncols: int,
     nlevels: int = 30,
+    use_pallas: bool | None = None,
 ) -> jnp.ndarray:
     """(N,) chaos scores; matches metrics_np.measure_of_chaos semantics:
     thresholds vmax * i/nlevels for i in 0..nlevels-1, 4-connectivity,
-    chaos = max(0, 1 - mean(component counts)/n_nonzero), 0 for empty."""
+    chaos = max(0, 1 - mean(component counts)/n_nonzero), 0 for empty.
+
+    On TPU the per-level component counts come from the VMEM-resident Pallas
+    kernel (ops/chaos_pallas.py, ~8x the associative-scan path); elsewhere
+    (CPU test meshes, interpreters) the scan path below is used.  Both are
+    exact, so the dispatch cannot change results.
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
     principal = jnp.maximum(principal, 0.0)
     vmax = principal.max(axis=1)                       # (N,)
     n_notnull = jnp.sum(principal > 0, axis=1)         # (N,)
 
-    def per_level(_, frac):
-        levels = vmax * frac                            # (N,)
-        masks = principal > levels[:, None]             # (N, n_pix)
-        counts = jax.vmap(partial(_cc_count, nrows=nrows, ncols=ncols))(masks)
-        return _, counts.astype(jnp.float32)
+    if use_pallas:
+        from .chaos_pallas import chaos_count_sums
 
-    fracs = jnp.arange(nlevels, dtype=jnp.float32) / nlevels
-    _, counts = lax.scan(per_level, None, fracs)        # (nlevels, N)
-    mean_counts = counts.mean(axis=0)
+        count_sums = chaos_count_sums(
+            principal, nrows=nrows, ncols=ncols, nlevels=nlevels)
+        mean_counts = count_sums / nlevels
+    else:
+        def per_level(_, frac):
+            levels = vmax * frac                        # (N,)
+            masks = principal > levels[:, None]         # (N, n_pix)
+            counts = jax.vmap(partial(_cc_count, nrows=nrows, ncols=ncols))(masks)
+            return _, counts.astype(jnp.float32)
+
+        fracs = jnp.arange(nlevels, dtype=jnp.float32) / nlevels
+        _, counts = lax.scan(per_level, None, fracs)    # (nlevels, N)
+        mean_counts = counts.mean(axis=0)
     chaos = 1.0 - mean_counts / jnp.maximum(n_notnull, 1)
     chaos = jnp.clip(chaos, 0.0, 1.0)
     return jnp.where((vmax > 0) & (n_notnull > 0), chaos, 0.0)

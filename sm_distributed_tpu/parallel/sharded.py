@@ -134,6 +134,11 @@ class ShardedJaxBackend:
             q=img_cfg.q,
         )
 
+    def score_batches(self, tables) -> list[np.ndarray]:
+        """Sequential for now; the comms-reworked pipelined variant is the
+        round-2 sharded redesign target."""
+        return [self.score_batch(t) for t in tables]
+
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         n = table.n_ions
         b = self.batch

@@ -1,0 +1,95 @@
+"""Parity tests for the Pallas measure_of_chaos kernel (ops/chaos_pallas.py).
+
+On the CPU test mesh the kernel runs in Pallas interpret mode — same kernel
+code, bit-exact semantics, no TPU required (the reference's local[*] trick,
+SURVEY.md §4).  The oracle is scipy.ndimage.label via ops/metrics_np.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from sm_distributed_tpu.ops.chaos_pallas import chaos_count_sums
+from sm_distributed_tpu.ops.metrics_np import measure_of_chaos
+
+_S4 = [[0, 1, 0], [1, 1, 1], [0, 1, 0]]
+
+
+def _oracle_count_sum(img2d: np.ndarray, nlevels: int) -> int:
+    """Sum over levels of 4-connectivity component counts, with the kernel's
+    exact threshold grid (f32 vmax * i/nlevels)."""
+    img = np.maximum(img2d.astype(np.float32), 0.0)
+    vmax = img.max()
+    total = 0
+    for li in range(nlevels):
+        thr = vmax * (np.float32(li) / np.float32(nlevels))
+        _, n = ndimage.label(img > thr, structure=_S4)
+        total += n
+    return total
+
+
+@pytest.mark.parametrize("shape", [(8, 8), (12, 10), (16, 33)])
+def test_random_masks_match_scipy(rng, shape):
+    r, c = shape
+    n = 6
+    imgs = np.where(rng.random((n, r * c)) < 0.45,
+                    rng.random((n, r * c)), 0).astype(np.float32)
+    got = np.asarray(chaos_count_sums(imgs, nrows=r, ncols=c, nlevels=6,
+                                      interpret=True))
+    for i in range(n):
+        assert got[i] == _oracle_count_sum(imgs[i].reshape(r, c), 6)
+
+
+def test_serpentine_single_component():
+    r = c = 16
+    img = np.zeros((r, c), np.float32)
+    for row in range(0, r, 2):
+        img[row, :] = 1.0
+        if row + 1 < r:
+            img[row + 1, c - 1 if (row // 2) % 2 == 0 else 0] = 1.0
+    got = np.asarray(chaos_count_sums(img.reshape(1, -1), nrows=r, ncols=c,
+                                      nlevels=1, interpret=True))
+    assert got[0] == 1
+
+
+def test_empty_and_full_images():
+    r = c = 8
+    empty = np.zeros((1, r * c), np.float32)
+    full = np.ones((1, r * c), np.float32)
+    assert np.asarray(chaos_count_sums(empty, nrows=r, ncols=c, nlevels=4,
+                                       interpret=True))[0] == 0
+    # full image: every level threshold vmax*i/4 keeps i=0..3 -> mask full
+    # except the last level... thresholds < vmax keep all pixels: 1 comp each
+    assert np.asarray(chaos_count_sums(full, nrows=r, ncols=c, nlevels=4,
+                                       interpret=True))[0] == 4
+
+
+def test_matches_full_chaos_oracle(rng):
+    """End metric parity: chaos from kernel counts == metrics_np formula."""
+    r, c, n, nlevels = 10, 14, 5, 8
+    imgs = np.where(rng.random((n, r * c)) < 0.3,
+                    rng.random((n, r * c)), 0).astype(np.float32)
+    sums = np.asarray(chaos_count_sums(imgs, nrows=r, ncols=c,
+                                       nlevels=nlevels, interpret=True))
+    for i in range(n):
+        n_notnull = (imgs[i] > 0).sum()
+        if n_notnull == 0:
+            continue
+        got = 1.0 - (sums[i] / nlevels) / n_notnull
+        want = measure_of_chaos(imgs[i].reshape(r, c).astype(np.float64), nlevels)
+        assert got == pytest.approx(want, abs=2e-6)
+
+
+def test_image_isolation_across_lane_packing(rng):
+    """Images packed side by side in lanes must not leak labels: a batch of
+    identical images must all get identical counts, and differ-by-one images
+    must stay independent."""
+    r = c = 8
+    base = np.where(rng.random(r * c) < 0.5, rng.random(r * c), 0).astype(np.float32)
+    batch = np.stack([base] * 7 + [np.zeros(r * c, np.float32)])
+    got = np.asarray(chaos_count_sums(batch, nrows=r, ncols=c, nlevels=3,
+                                      interpret=True))
+    assert (got[:7] == got[0]).all()
+    assert got[7] == 0
