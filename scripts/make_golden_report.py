@@ -1,0 +1,70 @@
+"""Regenerate the frozen golden report (tests/data/golden_spheroid.json).
+
+The sci-regression tier of the reference pins every ion's metrics against a
+committed report (``tests/sci_test_search_job_spheroid_dataset.py`` +
+``tests/reports/`` [U], SURVEY.md §4).  This is our analog: BASELINE config
+#1 (32x32 spheroid fixture, 50 formulas, +H) through the numpy_ref backend.
+
+Run ONLY when an intentional semantic change invalidates the report; commit
+the diff with the rationale.  Usage: python scripts/make_golden_report.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from sm_distributed_tpu.io.dataset import SpectralDataset          # noqa: E402
+from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset  # noqa: E402
+from sm_distributed_tpu.models.msm_basic import MSMBasicSearch     # noqa: E402
+from sm_distributed_tpu.utils.config import DSConfig, SMConfig     # noqa: E402
+
+GOLDEN_PATH = Path(__file__).parent.parent / "tests" / "data" / "golden_spheroid.json"
+
+# fixed generation recipe == tests/test_golden_report.py (do not drift)
+GEN = dict(nrows=32, ncols=32, formulas=None, present_fraction=0.6,
+           noise_peaks=200, mz_jitter_ppm=0.5, seed=7)
+SM = {"backend": "numpy_ref", "fdr": {"decoy_sample_size": 20, "seed": 42},
+      "parallel": {"formula_batch": 256}}
+DS = {"isotope_generation": {"adducts": ["+H"]},
+      "image_generation": {"ppm": 3.0}}
+
+
+def build_bundle(tmp_dir: str | Path, backend: str = "numpy_ref"):
+    path, truth = generate_synthetic_dataset(Path(tmp_dir), **GEN)
+    ds = SpectralDataset.from_imzml(path)
+    sm = dict(SM, backend=backend)
+    search = MSMBasicSearch(ds, truth.formulas, DSConfig.from_dict(DS),
+                            SMConfig.from_dict(sm))
+    return search.search()
+
+
+def main() -> None:
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        bundle = build_bundle(td)
+    report = {
+        "all_metrics": [
+            {"sf": r.sf, "adduct": r.adduct, "is_target": bool(r.is_target),
+             "chaos": float(r.chaos), "spatial": float(r.spatial),
+             "spectral": float(r.spectral), "msm": float(r.msm)}
+            for r in bundle.all_metrics.itertuples()
+        ],
+        "annotations": [
+            {"sf": r.sf, "adduct": r.adduct, "msm": float(r.msm),
+             "fdr": float(r.fdr), "fdr_level": float(r.fdr_level)}
+            for r in bundle.annotations.itertuples()
+        ],
+    }
+    GOLDEN_PATH.parent.mkdir(parents=True, exist_ok=True)
+    GOLDEN_PATH.write_text(json.dumps(report, indent=1))
+    print(f"wrote {GOLDEN_PATH}: {len(report['all_metrics'])} ions, "
+          f"{len(report['annotations'])} annotations")
+
+
+if __name__ == "__main__":
+    main()

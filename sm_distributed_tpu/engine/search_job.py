@@ -158,7 +158,7 @@ class SearchJob:
             n_valid=table.n_valid[idx],
             targets=table.targets[idx],
         )
-        view = SortedPeakView.prepare(ds)
+        view = SortedPeakView.prepare(ds, self.ds_config.image_generation.ppm)
         images = extract_ion_images(view, sub, self.ds_config.image_generation.ppm)
         path = self.store.store_ion_images(
             self.ds_id, np.asarray(images),

@@ -38,6 +38,29 @@ class SpectralDataset:
     def n_pixels(self) -> int:
         return self.nrows * self.ncols
 
+    # -- order-free exact intensity grid (ops/quantize.py) ---------------
+
+    def intensity_quantization(self, ppm: float) -> tuple[np.ndarray, float]:
+        """(integer-valued f32 intensities, power-of-two scale) for ``ppm``.
+
+        Both backends extract ion images from this shared grid, which makes
+        image pixel values bit-identical regardless of summation order,
+        backend, or shard count (the exact-FDR-rank requirement).  Cached
+        per ppm.
+        """
+        from ..ops.quantize import intensity_scale, quantize_intensities
+
+        cache = getattr(self, "_int_q_cache", None)
+        if cache is None:
+            cache = {}
+            object.__setattr__(self, "_int_q_cache", cache)
+        if ppm not in cache:
+            pixel_of_peak = np.repeat(
+                np.arange(self.n_pixels, dtype=np.int64), self.row_lengths())
+            scale = intensity_scale(self.mzs_flat, self.ints_flat, pixel_of_peak, ppm)
+            cache[ppm] = (quantize_intensities(self.ints_flat, scale), scale)
+        return cache[ppm]
+
     @property
     def n_spectra(self) -> int:
         return int(self.pixel_inds.size)

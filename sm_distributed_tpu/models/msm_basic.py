@@ -43,7 +43,9 @@ class NumpyBackend:
     def __init__(self, ds: SpectralDataset, ds_config: DSConfig):
         self.ds = ds
         self.ds_config = ds_config
-        self._view = SortedPeakView.prepare(ds)  # sort once, reuse per batch
+        # sort once, reuse per batch; ppm selects the shared integer
+        # intensity grid (exact cross-backend image parity)
+        self._view = SortedPeakView.prepare(ds, ds_config.image_generation.ppm)
 
     def score_batches(self, tables) -> list[np.ndarray]:
         """Score an iterable of batches one at a time (no pipelining on CPU;

@@ -64,7 +64,8 @@ class JaxBackend:
         img_cfg = ds_config.image_generation
         self.ppm = img_cfg.ppm
 
-        mz_q, int_cube = prepare_cube_arrays(ds)
+        mz_q, int_cube = prepare_cube_arrays(ds, ppm=self.ppm)
+        self.int_scale = ds.intensity_quantization(self.ppm)[1]
         self._mz_q = jax.device_put(mz_q)
         self._ints = jax.device_put(int_cube)
         logger.info(

@@ -41,13 +41,26 @@ from .quantize import quantize_mz
 
 
 def prepare_cube_arrays(
-    ds: SpectralDataset, pad_to_multiple: int = 128, pixels_multiple: int = 1
+    ds: SpectralDataset,
+    pad_to_multiple: int = 128,
+    pixels_multiple: int = 1,
+    ppm: float | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Host-side: (mz_q_cube int32 (P, L), int_cube float32 (P, L)).
 
     m/z rows are quantized (padding saturates to the MZ_PAD_Q sentinel, above
-    every real window bound, so padded peaks land past every rank)."""
+    every real window bound, so padded peaks land past every rank).  With
+    ``ppm`` given, intensities come from the shared integer grid
+    (ds.intensity_quantization): every per-(pixel, window) sum stays below
+    2**24, so scatter-add and matmul accumulation are EXACT in f32 in any
+    order — image bits equal the numpy oracle's."""
     mz_cube, int_cube, _lens = ds.padded_cube(pad_to_multiple, pixels_multiple)
+    if ppm is not None:
+        ints_q, _scale = ds.intensity_quantization(ppm)
+        lens = ds.row_lengths()
+        pixel_of_peak = np.repeat(np.arange(ds.n_pixels), lens)
+        col_of_peak = np.arange(ints_q.size) - np.repeat(ds.row_ptr[:-1], lens)
+        int_cube[pixel_of_peak, col_of_peak] = ints_q
     return quantize_mz(mz_cube), int_cube
 
 

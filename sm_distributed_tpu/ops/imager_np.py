@@ -31,11 +31,19 @@ class SortedPeakView:
 
     n_pixels: int
     g_mzs_q: np.ndarray        # (P,) int32, ascending
-    g_ints: np.ndarray         # (P,) f32
+    g_ints: np.ndarray         # (P,) f32 — integer-valued when ppm given
     pixel_of_peak: np.ndarray  # (P,) i64 — dense pixel index per sorted peak
+    int_scale: float = 1.0     # power-of-two intensity-grid scale
 
     @classmethod
-    def prepare(cls, ds: SpectralDataset) -> "SortedPeakView":
+    def prepare(cls, ds: SpectralDataset, ppm: float | None = None) -> "SortedPeakView":
+        """With ``ppm`` given, intensities come from the shared integer grid
+        (ds.intensity_quantization) — bit-identical images vs the jax backend
+        under any summation order.  Without it, raw intensities (legacy)."""
+        if ppm is not None:
+            ints, scale = ds.intensity_quantization(ppm)
+        else:
+            ints, scale = ds.ints_flat, 1.0
         g_mzs_q_unsorted = quantize_mz(ds.mzs_flat)
         order = np.argsort(g_mzs_q_unsorted, kind="stable")
         pixel_of_peak = np.repeat(
@@ -44,8 +52,9 @@ class SortedPeakView:
         return cls(
             n_pixels=ds.n_pixels,
             g_mzs_q=g_mzs_q_unsorted[order],
-            g_ints=ds.ints_flat[order],
+            g_ints=ints[order],
             pixel_of_peak=pixel_of_peak,
+            int_scale=scale,
         )
 
 
@@ -57,11 +66,15 @@ def extract_ion_images(
     """Dense ion images: (n_ions, max_peaks, n_pixels) float32.
 
     Matching happens on the shared quantized m/z grid (ops/quantize.py) so the
-    hit set is exactly the jax_tpu backend's.  Padded (invalid) isotope peaks
-    yield all-zero images, like the reference's missing sparse matrices.
-    Pass a prebuilt SortedPeakView when scoring many batches.
+    hit set is exactly the jax_tpu backend's, and intensities on the shared
+    integer grid so pixel SUMS are bit-identical too (order-free; see
+    ops/quantize.py).  Output images are de-quantized back to raw units (an
+    exact power-of-two division).  Padded (invalid) isotope peaks yield
+    all-zero images, like the reference's missing sparse matrices.  Pass a
+    prebuilt SortedPeakView when scoring many batches.
     """
-    view = source if isinstance(source, SortedPeakView) else SortedPeakView.prepare(source)
+    view = (source if isinstance(source, SortedPeakView)
+            else SortedPeakView.prepare(source, ppm))
 
     lo, hi = quantize_window(table.mzs, ppm)
     start = np.searchsorted(view.g_mzs_q, lo.ravel(), side="left").reshape(lo.shape)
@@ -80,4 +93,6 @@ def extract_ion_images(
                     view.pixel_of_peak[s:e], weights=view.g_ints[s:e],
                     minlength=view.n_pixels,
                 ).astype(np.float32)
+    if view.int_scale != 1.0:
+        images /= np.float32(view.int_scale)   # exact: scale is a power of two
     return images

@@ -99,7 +99,6 @@ def measure_of_chaos_batch(
 
         count_sums = chaos_count_sums(
             principal, nrows=nrows, ncols=ncols, nlevels=nlevels)
-        mean_counts = count_sums / nlevels
     else:
         def per_level(_, frac):
             levels = vmax * frac                        # (N,)
@@ -109,8 +108,17 @@ def measure_of_chaos_batch(
 
         fracs = jnp.arange(nlevels, dtype=jnp.float32) / nlevels
         _, counts = lax.scan(per_level, None, fracs)    # (nlevels, N)
-        mean_counts = counts.mean(axis=0)
-    chaos = 1.0 - mean_counts / jnp.maximum(n_notnull, 1)
+        count_sums = counts.sum(axis=0)                 # exact small integers
+    # ONE division by a runtime denominator: "count_sums / nlevels" would let
+    # XLA strength-reduce the constant divisor into a reciprocal multiply
+    # (different rounding than numpy's true division — observed 1-ulp chaos
+    # drift); nlevels * n_notnull is exact in f32 (< 2**24).  On CPU this
+    # makes chaos bit-identical to the oracle; the TPU VPU's division is
+    # itself reciprocal-based (not correctly rounded), so on TPU chaos can
+    # still sit 1 ulp off — FDR ranks/levels remain exactly identical (the
+    # north-star criterion; verified on-chip in round 2)
+    denom = (nlevels * jnp.maximum(n_notnull, 1)).astype(jnp.float32)
+    chaos = 1.0 - count_sums / denom
     chaos = jnp.clip(chaos, 0.0, 1.0)
     return jnp.where((vmax > 0) & (n_notnull > 0), chaos, 0.0)
 

@@ -42,22 +42,32 @@ def hotspot_clip(img: np.ndarray, q: float = 99.0) -> np.ndarray:
 def measure_of_chaos(img: np.ndarray, nlevels: int = 30) -> float:
     """Spatial chaos of a 2-D image in [0, 1]; 0 for empty images.
 
-    Thresholds: ``nlevels`` levels evenly spaced in (0, max) —
-    ``linspace(0, max, nlevels, endpoint=False)`` (level 0 counts the
-    support's components).  Connectivity: 4-neighbour.
+    Thresholds: ``nlevels`` levels evenly spaced in (0, max) — level i is
+    ``vmax * i/nlevels`` (level 0 counts the support's components).
+    Connectivity: 4-neighbour.
+
+    The threshold grid and the final mean/normalize arithmetic are computed
+    in float32, mirroring the TPU kernel bit for bit: at integer-grid image
+    magnitudes (up to 2**24) the f32/f64 threshold representations can differ
+    by ~0.5, enough to flip a mask pixel — the f32 grid is the definition,
+    in both backends (exact-FDR-rank requirement).
     """
-    img = np.nan_to_num(np.asarray(img, dtype=np.float64))
-    img = np.where(img > 0, img, 0.0)
-    vmax = img.max()
+    img = np.nan_to_num(np.asarray(img, dtype=np.float32))
+    img = np.where(img > 0, img, np.float32(0.0))
+    vmax = np.float32(img.max())
     n_notnull = int((img > 0).sum())
     if vmax <= 0 or n_notnull == 0:
         return 0.0
-    levels = np.linspace(0.0, vmax, nlevels, endpoint=False)
-    counts = np.empty(nlevels)
-    for i, lev in enumerate(levels):
+    count_sum = 0
+    for i in range(nlevels):
+        lev = vmax * (np.float32(i) / np.float32(nlevels))
         _, n = ndimage.label(img > lev, structure=_STRUCTURE4)
-        counts[i] = n
-    return float(max(0.0, 1.0 - counts.mean() / n_notnull))
+        count_sum += n
+    # single division, mirroring the TPU kernel (see metrics_jax: a constant
+    # divisor would be strength-reduced to a reciprocal multiply by XLA)
+    chaos = np.float32(1.0) - np.float32(count_sum) / np.float32(
+        nlevels * max(n_notnull, 1))
+    return float(np.clip(chaos, np.float32(0.0), np.float32(1.0)))
 
 
 def isotope_image_correlation(

@@ -114,7 +114,9 @@ class ShardedJaxBackend:
         img_cfg = ds_config.image_generation
         self.ppm = img_cfg.ppm
 
-        mz_q, int_cube = prepare_cube_arrays(ds, pixels_multiple=n_pix_shards)
+        mz_q, int_cube = prepare_cube_arrays(
+            ds, pixels_multiple=n_pix_shards, ppm=self.ppm)
+        self.int_scale = ds.intensity_quantization(self.ppm)[1]
         cube_sharding = NamedSharding(self.mesh, P(PIXELS_AXIS, None))
         self._mz_q = jax.device_put(mz_q, cube_sharding)
         self._ints = jax.device_put(int_cube, cube_sharding)

@@ -1,0 +1,59 @@
+"""Frozen golden-report regression — the sci-test tier.
+
+Reference: ``tests/sci_test_search_job_spheroid_dataset.py`` + frozen report
+under ``tests/reports/`` [U] (SURVEY.md §4): every ion's (chaos, spatial,
+spectral, msm) and the FDR outcome are pinned against a COMMITTED file, so a
+change that drifts both backends together (e.g. an isocalc or metrics edit)
+fails loudly across rounds instead of passing dynamic backend-vs-backend
+parity.  Regenerate deliberately with scripts/make_golden_report.py.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from scripts.make_golden_report import GOLDEN_PATH, build_bundle
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+@pytest.fixture(scope="module")
+def golden():
+    assert GOLDEN_PATH.exists(), (
+        "golden report missing — run scripts/make_golden_report.py and commit")
+    return json.loads(GOLDEN_PATH.read_text())
+
+
+@pytest.fixture(scope="module", params=["numpy_ref", "jax_tpu"])
+def bundle(request, tmp_path_factory):
+    td = tmp_path_factory.mktemp(f"golden_{request.param}")
+    return build_bundle(td, backend=request.param)
+
+
+def test_metrics_match_golden(golden, bundle):
+    got = {(r.sf, r.adduct): r for r in bundle.all_metrics.itertuples()}
+    want = golden["all_metrics"]
+    assert len(got) == len(want)
+    for w in want:
+        g = got[(w["sf"], w["adduct"])]
+        assert bool(g.is_target) == w["is_target"]
+        for col in ("chaos", "spatial", "spectral", "msm"):
+            assert getattr(g, col) == pytest.approx(w[col], abs=1e-6), (
+                f"{col} drifted for {w['sf']}{w['adduct']}")
+
+
+def test_annotations_match_golden(golden, bundle):
+    ann = bundle.annotations
+    want = golden["annotations"]
+    assert [(r.sf, r.adduct) for r in ann.itertuples()] == [
+        (w["sf"], w["adduct"]) for w in want], "annotation ORDER drifted"
+    np.testing.assert_allclose(
+        ann.msm.to_numpy(), [w["msm"] for w in want], atol=1e-6)
+    np.testing.assert_array_equal(
+        ann.fdr.to_numpy(), [w["fdr"] for w in want])
+    np.testing.assert_array_equal(
+        ann.fdr_level.to_numpy(), [w["fdr_level"] for w in want])
