@@ -1,0 +1,62 @@
+"""Multi-host runtime init — the DCN analog of the reference's Spark cluster.
+
+Reference: ``sm_config['spark']`` carries the cluster master address and
+executor settings [U] (SURVEY.md #20, §5.8).  The TPU-native equivalent is
+single-controller JAX: every host process calls
+``jax.distributed.initialize(coordinator, num_processes, process_id)`` and
+``jax.devices()`` then spans all hosts; the ("pixels", "formulas") mesh and
+its collectives (all_to_all over ICI within a slice, DCN across slices) need
+no further changes — shard_map code is topology-agnostic.
+
+Launch (one process per host), e.g.:
+
+    SM_COORDINATOR=host0:8476 SM_NUM_PROCESSES=4 SM_PROCESS_ID=$i \
+        python -m sm_distributed_tpu.engine.cli run ...
+
+or set ``parallel.coordinator_address`` / ``num_processes`` / ``process_id``
+in the engine config.  On Cloud TPU pods, plain ``jax.distributed
+.initialize()`` auto-discovers everything; we pass explicit values only when
+configured.  Single-process (the default) is a strict no-op.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..utils.config import ParallelConfig
+from ..utils.logger import logger
+
+_initialized = False
+
+
+def resolve_distributed_settings(cfg: ParallelConfig) -> tuple[str, int, int]:
+    """(coordinator, num_processes, process_id) from env (priority) or cfg."""
+    coord = os.environ.get("SM_COORDINATOR", cfg.coordinator_address)
+    n_proc = int(os.environ.get("SM_NUM_PROCESSES", cfg.num_processes))
+    proc_id = int(os.environ.get("SM_PROCESS_ID", cfg.process_id))
+    return coord, n_proc, proc_id
+
+
+def maybe_initialize_distributed(cfg: ParallelConfig) -> bool:
+    """Initialize the multi-host runtime when configured; returns True when
+    jax.distributed.initialize was called.  Idempotent; single-process
+    settings (num_processes <= 1 and no coordinator) are a no-op."""
+    global _initialized
+    coord, n_proc, proc_id = resolve_distributed_settings(cfg)
+    if n_proc <= 1 and not coord:
+        return False
+    if _initialized:
+        return True
+    import jax
+
+    kwargs = {}
+    if coord:
+        kwargs["coordinator_address"] = coord
+    if n_proc > 1:
+        kwargs["num_processes"] = n_proc
+    if proc_id >= 0:
+        kwargs["process_id"] = proc_id
+    logger.info("initializing multi-host runtime: %s", kwargs)
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return True

@@ -35,8 +35,10 @@ def resolve_axis_sizes(n_devices: int, cfg: ParallelConfig) -> tuple[int, int]:
     Both -1: all devices go to the pixel axis (the dominant data axis).
     """
     pix, form = cfg.pixels_axis, cfg.formulas_axis
-    if pix == 0 or form == 0:
-        raise ValueError("mesh axis sizes must be -1 or positive")
+    if pix < -1 or form < -1 or pix == 0 or form == 0:
+        raise ValueError(
+            f"mesh axis sizes must be -1 or positive, got pixels_axis={pix}, "
+            f"formulas_axis={form}")
     if pix == -1 and form == -1:
         pix, form = n_devices, 1
     elif pix == -1:

@@ -112,6 +112,12 @@ class ParallelConfig:
     formulas_axis: int = 1               # mesh axis sharding the formula dimension
     formula_batch: int = 512             # ions scored per fused-graph invocation
     mz_chunk: int = 0                    # 0 = no m/z chunking inside the kernel
+    # multi-host (DCN) runtime — jax.distributed.initialize; the analog of
+    # the reference's spark.master cluster address (SURVEY.md §5.8).  Env
+    # vars SM_COORDINATOR / SM_NUM_PROCESSES / SM_PROCESS_ID override.
+    coordinator_address: str = ""        # "" = single-process (no-op init)
+    num_processes: int = 1
+    process_id: int = -1                 # -1 = resolve from env/launcher
 
 
 @dataclass(frozen=True)

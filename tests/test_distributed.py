@@ -1,0 +1,57 @@
+"""Multi-host init wiring (parallel/distributed.py): single-process must be a
+strict no-op; settings resolve env over config (SURVEY.md §5.8 mapping)."""
+
+import numpy as np
+import pytest
+
+from sm_distributed_tpu.parallel import distributed
+from sm_distributed_tpu.parallel.mesh import resolve_axis_sizes
+from sm_distributed_tpu.utils.config import ParallelConfig
+
+
+def test_single_process_is_noop(monkeypatch):
+    monkeypatch.delenv("SM_COORDINATOR", raising=False)
+    monkeypatch.delenv("SM_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("SM_PROCESS_ID", raising=False)
+    assert distributed.maybe_initialize_distributed(ParallelConfig()) is False
+    assert distributed._initialized is False
+
+
+def test_settings_env_overrides_config(monkeypatch):
+    cfg = ParallelConfig(coordinator_address="cfghost:1", num_processes=2, process_id=0)
+    monkeypatch.setenv("SM_COORDINATOR", "envhost:2")
+    monkeypatch.setenv("SM_NUM_PROCESSES", "4")
+    monkeypatch.setenv("SM_PROCESS_ID", "3")
+    assert distributed.resolve_distributed_settings(cfg) == ("envhost:2", 4, 3)
+    monkeypatch.delenv("SM_COORDINATOR")
+    monkeypatch.delenv("SM_NUM_PROCESSES")
+    monkeypatch.delenv("SM_PROCESS_ID")
+    assert distributed.resolve_distributed_settings(cfg) == ("cfghost:1", 2, 0)
+
+
+def test_multiprocess_calls_initialize(monkeypatch):
+    calls = {}
+
+    def fake_init(**kwargs):
+        calls.update(kwargs)
+
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(distributed, "_initialized", False)
+    cfg = ParallelConfig(coordinator_address="h0:8476", num_processes=2, process_id=1)
+    assert distributed.maybe_initialize_distributed(cfg) is True
+    assert calls == {"coordinator_address": "h0:8476", "num_processes": 2,
+                     "process_id": 1}
+    # idempotent: second call does not re-initialize
+    calls.clear()
+    assert distributed.maybe_initialize_distributed(cfg) is True
+    assert calls == {}
+    monkeypatch.setattr(distributed, "_initialized", False)
+
+
+def test_mesh_axis_validation_rejects_bad_negatives():
+    with pytest.raises(ValueError):
+        resolve_axis_sizes(8, ParallelConfig(pixels_axis=-2, formulas_axis=1))
+    with pytest.raises(ValueError):
+        resolve_axis_sizes(8, ParallelConfig(pixels_axis=1, formulas_axis=-3))
