@@ -19,7 +19,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.dataset import SpectralDataset
-from ..ops.imager_jax import extract_images, prepare_cube_arrays, window_rank_grid
+from ..ops.imager_jax import (
+    extract_images,
+    extract_images_mz_chunked,
+    prepare_cube_arrays,
+    window_chunks,
+    window_rank_grid,
+)
 from ..ops.isocalc import IsotopePatternTable
 from ..ops.metrics_jax import batch_metrics
 from ..ops.quantize import quantize_window
@@ -52,6 +58,42 @@ def fused_score_fn(
     )
 
 
+def fused_score_fn_chunked(
+    mz_q_cube: jnp.ndarray,
+    int_cube: jnp.ndarray,
+    grid: jnp.ndarray,
+    starts: jnp.ndarray,       # (C,) chunk grid offsets
+    r_lo_loc: jnp.ndarray,     # (C, Wc)
+    r_hi_loc: jnp.ndarray,     # (C, Wc)
+    inv: jnp.ndarray,          # (B*K,)
+    theor_ints: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    *,
+    gc_width: int,
+    b: int,
+    k: int,
+    nrows: int,
+    ncols: int,
+    nlevels: int,
+    do_preprocessing: bool,
+    q: float,
+) -> jnp.ndarray:
+    """As fused_score_fn, but extraction loops over m/z chunks so the
+    histogram scratch is bounded at (P, gc_width+2) — SURVEY §5.7 m/z-segment
+    axis.  Ion images (and hence chaos, which is integer-count based) are
+    bit-identical to the unchunked path; spatial/spectral can differ by ulps
+    because XLA picks different reduction fusions for the two program
+    variants (observed at 128x128 px on TPU)."""
+    imgs = extract_images_mz_chunked(
+        mz_q_cube, int_cube, grid, starts, r_lo_loc, r_hi_loc, inv,
+        gc_width=gc_width)
+    imgs = imgs.reshape(b, k, -1)[:, :, : nrows * ncols]
+    return batch_metrics(
+        imgs, theor_ints, n_valid, nrows, ncols, nlevels,
+        do_preprocessing=do_preprocessing, q=q,
+    )
+
+
 class JaxBackend:
     """Fused-graph scorer selected by ``SMConfig.backend == 'jax_tpu'``."""
 
@@ -72,16 +114,21 @@ class JaxBackend:
             "jax_tpu cube resident: %s int32 + %s f32 on %s",
             mz_q.shape, int_cube.shape, self._mz_q.devices(),
         )
-        self._fn = jax.jit(
-            partial(
-                fused_score_fn,
-                nrows=ds.nrows,
-                ncols=ds.ncols,
-                nlevels=img_cfg.nlevels,
-                do_preprocessing=img_cfg.do_preprocessing,
-                q=img_cfg.q,
-            )
+        self.mz_chunk = max(0, sm_config.parallel.mz_chunk)
+        common = dict(
+            nrows=ds.nrows,
+            ncols=ds.ncols,
+            nlevels=img_cfg.nlevels,
+            do_preprocessing=img_cfg.do_preprocessing,
+            q=img_cfg.q,
         )
+        if self.mz_chunk:
+            self._fn = jax.jit(
+                partial(fused_score_fn_chunked, **common),
+                static_argnames=("gc_width", "b", "k"),
+            )
+        else:
+            self._fn = jax.jit(partial(fused_score_fn, **common))
 
     def _dispatch(self, table: IsotopePatternTable):
         """Async: enqueue one padded batch on device, return (device_out, n)."""
@@ -102,9 +149,17 @@ class JaxBackend:
         grid, r_lo, r_hi = window_rank_grid(lo_p, hi_p)
         # explicit async device_put: the transfers overlap device compute of
         # previously enqueued batches instead of blocking the dispatch path
-        args = [jax.device_put(a) for a in (
-            grid, r_lo.reshape(b, k), r_hi.reshape(b, k), ints_p, nv_p)]
-        out = self._fn(self._mz_q, self._ints, *args)
+        if self.mz_chunk:
+            starts, r_lo_loc, r_hi_loc, inv, gc_width = window_chunks(
+                r_lo, r_hi, self.mz_chunk)
+            args = [jax.device_put(a) for a in (
+                grid, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
+            out = self._fn(self._mz_q, self._ints, *args,
+                           gc_width=gc_width, b=b, k=k)
+        else:
+            args = [jax.device_put(a) for a in (
+                grid, r_lo.reshape(b, k), r_hi.reshape(b, k), ints_p, nv_p)]
+            out = self._fn(self._mz_q, self._ints, *args)
         return out, n
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:

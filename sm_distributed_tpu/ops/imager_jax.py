@@ -108,3 +108,83 @@ def extract_images(
     d = ((gg > r_lo[None, :]) & (gg <= r_hi[None, :])).astype(jnp.float32)
     img_pw = jnp.dot(wh, d, precision=jax.lax.Precision.HIGHEST)  # (P, W)
     return img_pw.T
+
+
+# -- m/z-chunked extraction ---------------------------------------------------
+#
+# The reference segments the m/z range so each task's working set stays
+# bounded (``formula_imager_segm`` m/z segmentation [U], SURVEY.md §2d/§5.7).
+# The TPU analog: the histogram scratch above is (P, 2*B*K+1) f32 — ~3.3 GB
+# for a >200k-pixel slide at formula_batch=512 (ADVICE r1) — so with
+# ``ParallelConfig.mz_chunk`` set, windows are sorted by m/z and processed in
+# chunks whose LOCAL bound-grid slice bounds the scratch at (P, gc_width+2).
+# The global cube searchsorted happens ONCE (local bins are global bins minus
+# the chunk's grid offset); only the scatter-add repeats per chunk, trading
+# compute for an HBM ceiling.  Extracted images are bit-identical to the
+# unchunked path: hit sets are exact integer-grid matches and sums are exact
+# integers (ops/quantize.py) in any grouping.
+
+
+def window_chunks(
+    r_lo: np.ndarray, r_hi: np.ndarray, mz_chunk: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int]:
+    """Host-side chunk plan: (starts (C,), r_lo_loc (C, Wc), r_hi_loc (C, Wc),
+    inv (W,), gc_width).
+
+    Windows are ordered by lo rank and cut every ``mz_chunk`` windows; a
+    chunk's grid offset is its first window's lo rank; ``gc_width`` (the
+    max local rank span, rounded up to a power of two so recompiles are
+    rare) sizes the scratch.  ``inv`` maps sorted rows back to input order.
+    """
+    w = int(r_lo.size)
+    wc = max(1, int(mz_chunk))
+    c = max(1, -(-w // wc))
+    order = np.argsort(r_lo, kind="stable")
+    pad = c * wc - w
+    r_lo_s = np.concatenate([r_lo[order], np.zeros(pad, r_lo.dtype)]).reshape(c, wc)
+    r_hi_s = np.concatenate([r_hi[order], np.zeros(pad, r_hi.dtype)]).reshape(c, wc)
+    starts = r_lo_s[:, 0].astype(np.int32)
+    # padded tail windows: snap to the chunk offset -> empty local window
+    if pad:
+        r_lo_s[-1, wc - pad:] = starts[-1]
+        r_hi_s[-1, wc - pad:] = starts[-1]
+    r_lo_loc = (r_lo_s - starts[:, None]).astype(np.int32)
+    r_hi_loc = (r_hi_s - starts[:, None]).astype(np.int32)
+    span = int(r_hi_loc.max()) if w else 1
+    gc_width = 1 << int(np.ceil(np.log2(max(span, 2 * wc, 2))))
+    inv = np.empty(w, dtype=np.int32)
+    inv[order] = np.arange(w, dtype=np.int32)
+    return starts, r_lo_loc, r_hi_loc, inv, gc_width
+
+
+def extract_images_mz_chunked(
+    mz_q_cube: jnp.ndarray,   # (P, L) int32
+    int_cube: jnp.ndarray,    # (P, L) f32
+    grid: jnp.ndarray,        # (G,) int32 sorted window bounds (all chunks)
+    starts: jnp.ndarray,      # (C,) int32 grid offset per chunk
+    r_lo_loc: jnp.ndarray,    # (C, Wc) int32 local lo ranks
+    r_hi_loc: jnp.ndarray,    # (C, Wc) int32 local hi ranks
+    inv: jnp.ndarray,         # (W,) int32 sorted-row -> input-order map
+    *,
+    gc_width: int,
+) -> jnp.ndarray:
+    """(W, P) f32 ion-window images, scratch bounded at (P, gc_width+2)."""
+    p, _l = mz_q_cube.shape
+    bins_g = jnp.searchsorted(
+        grid, mz_q_cube.ravel(), side="right", method="sort"
+    ).reshape(p, -1)                                   # global bins, ONCE
+    rows = jnp.arange(p, dtype=jnp.int32)[:, None]
+    gg = jnp.arange(gc_width + 2, dtype=jnp.int32)[:, None]
+
+    def chunk(_, data):
+        start, rlo, rhi = data
+        # out-of-chunk peaks clip to bins 0 / gc_width+1, excluded from every
+        # window (local interiors are (rlo, rhi] with rlo >= 0, rhi <= gc_width)
+        lb = jnp.clip(bins_g - start, 0, gc_width + 1)
+        wh = jnp.zeros((p, gc_width + 2), jnp.float32).at[rows, lb].add(int_cube)
+        d = ((gg > rlo[None, :]) & (gg <= rhi[None, :])).astype(jnp.float32)
+        return None, jnp.dot(wh, d, precision=jax.lax.Precision.HIGHEST).T
+
+    _, imgs = jax.lax.scan(chunk, None, (starts, r_lo_loc, r_hi_loc))
+    imgs = imgs.reshape(-1, p)                         # (C*Wc, P) sorted order
+    return jnp.take(imgs, inv, axis=0)                 # (W, P) input order
