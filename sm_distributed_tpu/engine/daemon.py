@@ -89,15 +89,19 @@ class QueueConsumer:
         if claimed is None:
             return False
         msg: dict = {}
+        raw = ""
         try:
-            msg = json.loads(claimed.read_text())
+            raw = claimed.read_text()
+            msg = json.loads(raw)
             logger.info("queue: processing %s (ds %s)", claimed.name, msg.get("ds_id"))
             self.callback(msg)
         except Exception as exc:
             # poison messages (bad JSON) land in failed/ too, instead of
-            # crash-looping the consumer
-            msg["error"] = str(exc)
-            (self.root / "failed" / claimed.name).write_text(json.dumps(msg, indent=2))
+            # crash-looping the consumer; keep the RAW payload as evidence
+            # when parsing failed (ADVICE r1)
+            failed = dict(msg) if msg else {"raw": raw}
+            failed["error"] = str(exc)
+            (self.root / "failed" / claimed.name).write_text(json.dumps(failed, indent=2))
             claimed.unlink()
             logger.error("queue: %s FAILED: %s", claimed.name, exc)
             if self.on_failure:

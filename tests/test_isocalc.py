@@ -108,3 +108,56 @@ def test_h2o_single_dominant_peak():
     # M+1 of water is ~0.07% — far below M0
     if ints.size > 1:
         assert ints[1] < 0.2
+
+
+def test_parallel_pool_matches_serial(tmp_path):
+    """The multiprocessing fan-out (the reference's sc.parallelize analog,
+    SURVEY.md #7) must produce exactly the serial results."""
+    import numpy as np
+
+    from sm_distributed_tpu.io.fixtures import expand_formula_list
+    from sm_distributed_tpu.ops import isocalc as iso_mod
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    formulas = expand_formula_list(60)
+    pairs = [(sf, "+H") for sf in formulas] + [("NotAFormula!", "+H")]
+    # lower the threshold so the pool path actually runs on a small set
+    old = iso_mod._PARALLEL_THRESHOLD
+    iso_mod._PARALLEL_THRESHOLD = 10
+    try:
+        serial = IsocalcWrapper(IsotopeGenerationConfig(adducts=("+H",)), n_procs=1)
+        t_ser = serial.pattern_table(pairs)
+        par = IsocalcWrapper(
+            IsotopeGenerationConfig(adducts=("+H",)), cache_dir=tmp_path, n_procs=2)
+        t_par = par.pattern_table(pairs)
+    finally:
+        iso_mod._PARALLEL_THRESHOLD = old
+    assert t_ser.sfs == t_par.sfs
+    np.testing.assert_array_equal(t_ser.mzs, t_par.mzs)
+    np.testing.assert_array_equal(t_ser.ints, t_par.ints)
+
+
+def test_incremental_cache_shards(tmp_path):
+    """Each save writes only new entries (one shard per job); reload sees
+    the union; results identical after reload."""
+    import numpy as np
+
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    cfg = IsotopeGenerationConfig(adducts=("+H",))
+    c1 = IsocalcWrapper(cfg, cache_dir=tmp_path)
+    t1 = c1.pattern_table([("C6H12O6", "+H"), ("H2O", "+H")])
+    shards1 = list(tmp_path.glob("theor_peaks_*.npz"))
+    assert len(shards1) == 1
+    c2 = IsocalcWrapper(cfg, cache_dir=tmp_path)
+    t2 = c2.pattern_table([("C6H12O6", "+H"), ("C5H9NO4", "+H")])
+    shards2 = list(tmp_path.glob("theor_peaks_*.npz"))
+    assert len(shards2) == 2  # only the new formula went into a new shard
+    c3 = IsocalcWrapper(cfg, cache_dir=tmp_path)
+    assert len(c3._cache) == 3
+    t3 = c3.pattern_table([("C6H12O6", "+H")])
+    np.testing.assert_array_equal(t3.mzs[0], t1.mzs[0])
+    # a pure cache-hit job writes no new shard
+    assert len(list(tmp_path.glob("theor_peaks_*.npz"))) == 2
