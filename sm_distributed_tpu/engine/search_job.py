@@ -72,6 +72,7 @@ class SearchJob:
         job_id = self.ledger.start_job(self.ds_id)
         logger.info("job %d started for ds %s (%s)", job_id, self.ds_id, self.ds_name)
         prof = None
+        succeeded = False
         try:
             timings: dict[str, float] = {}
             with phase_timer("stage_input", timings):
@@ -91,6 +92,7 @@ class SearchJob:
             search = MSMBasicSearch(
                 ds, formulas, self.ds_config, self.sm_config,
                 isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),
+                checkpoint_dir=str(self.work_dir.path),
             )
             bundle = search.search()
             if prof:
@@ -116,7 +118,18 @@ class SearchJob:
                     self._store_annotation_images(ds, search, bundle)
                 self.store.store(self.ds_id, job_id, bundle, ion_mzs)
             self.ledger.finish_job(job_id)
+            if search.last_checkpoint is not None:
+                # only after results are durably persisted: a storage failure
+                # above must leave the checkpoint for the rerun to resume
+                # from; and a failed cleanup must not FAIL a finished job
+                try:
+                    search.last_checkpoint.finalize()
+                except OSError:
+                    logger.warning(
+                        "could not remove search checkpoint shards under %s",
+                        search.last_checkpoint.dir, exc_info=True)
             logger.info("job %d FINISHED (%d annotations)", job_id, len(bundle.annotations))
+            succeeded = True
             return bundle
         except Exception as exc:
             if prof:
@@ -130,8 +143,14 @@ class SearchJob:
             logger.error("job %d FAILED: %s", job_id, exc)
             raise
         finally:
-            if clean:
+            # on failure the work dir survives even with clean=True: it holds
+            # the checkpoint shards + staged input the rerun resumes from
+            if clean and succeeded:
                 self.work_dir.clean()
+            elif clean:
+                logger.info(
+                    "job failed: keeping work dir %s for resume",
+                    self.work_dir.path)
 
     def _store_annotation_images(
         self, ds: SpectralDataset, search: MSMBasicSearch, bundle: SearchResultsBundle

@@ -118,6 +118,10 @@ class ParallelConfig:
     coordinator_address: str = ""        # "" = single-process (no-op init)
     num_processes: int = 1
     process_id: int = -1                 # -1 = resolve from env/launcher
+    # mid-search resume (SURVEY §5.4): checkpoint scored metrics every N
+    # formula batches; 0 disables.  A killed multi-hour search (BASELINE
+    # configs #3/#5) resumes from the last complete group.
+    checkpoint_every: int = 0
 
 
 @dataclass(frozen=True)

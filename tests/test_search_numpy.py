@@ -118,6 +118,89 @@ def test_numpy_ref_search_end_to_end(synthetic_ds):
     assert len(decoys) > 0
 
 
+def test_search_checkpoint_resume(synthetic_ds, tmp_path, monkeypatch):
+    """Kill a search mid-way; the resumed run must (a) skip the checkpointed
+    batch groups and (b) produce results identical to an uninterrupted run,
+    and the checkpoint file is removed on success (SURVEY §5.4)."""
+    import pandas.testing as pdt
+
+    from sm_distributed_tpu.models import msm_basic as mb
+
+    ds, truth = synthetic_ds
+    sm_config = SMConfig.from_dict(
+        {"backend": "numpy_ref", "fdr": {"decoy_sample_size": 4, "seed": 5},
+         "parallel": {"formula_batch": 16, "checkpoint_every": 1}}
+    )
+    ds_config = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    sub = truth.formulas[:12]
+
+    baseline = MSMBasicSearch(ds, sub, ds_config, sm_config).search().annotations
+
+    orig = mb.NumpyBackend.score_batch
+    calls = {"n": 0}
+
+    def bomb(self, t):
+        calls["n"] += 1
+        if calls["n"] > 2:
+            raise KeyboardInterrupt  # simulated kill after 2 batch groups
+        return orig(self, t)
+
+    monkeypatch.setattr(mb.NumpyBackend, "score_batch", bomb)
+    job = MSMBasicSearch(ds, sub, ds_config, sm_config,
+                         checkpoint_dir=str(tmp_path))
+    with pytest.raises(KeyboardInterrupt):
+        job.search()
+    shards = sorted(tmp_path.glob("msm_search.p0.g*.ckpt.npz"))
+    assert len(shards) == 2  # one shard per completed batch group
+
+    resumed_calls = {"n": 0}
+
+    def count(self, t):
+        resumed_calls["n"] += 1
+        return orig(self, t)
+
+    monkeypatch.setattr(mb.NumpyBackend, "score_batch", count)
+    job2 = MSMBasicSearch(ds, sub, ds_config, sm_config,
+                          checkpoint_dir=str(tmp_path))
+    resumed = job2.search().annotations
+
+    n_batches = -(-job2.last_table.n_ions // 16)
+    assert resumed_calls["n"] == n_batches - 2  # skipped checkpointed groups
+    pdt.assert_frame_equal(resumed, baseline)
+    # search() itself keeps the checkpoint (downstream storage can still
+    # fail); the orchestrator finalizes after results persist
+    assert list(tmp_path.glob("msm_search.p0.g*.ckpt.npz"))
+    # an orphaned tmp from a kill between savez and os.replace is also swept
+    (tmp_path / "msm_search.p0.g00099.ckpt.tmp.npz").write_bytes(b"junk")
+    job2.last_checkpoint.finalize()
+    assert not list(tmp_path.glob("msm_search.p0.g*"))
+
+
+def test_search_checkpoint_stale_ignored(synthetic_ds, tmp_path):
+    """A checkpoint from a different search (different formulas) must not be
+    trusted — the fingerprint mismatch forces a clean rescore."""
+    ds, truth = synthetic_ds
+    sm_config = SMConfig.from_dict(
+        {"backend": "numpy_ref", "fdr": {"decoy_sample_size": 4, "seed": 5},
+         "parallel": {"formula_batch": 16, "checkpoint_every": 1}}
+    )
+    ds_config = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+
+    # plant a checkpoint from formulas[:6]
+    from sm_distributed_tpu.models.msm_basic import SearchCheckpoint
+
+    stale = SearchCheckpoint(tmp_path, "deadbeef")
+    stale.save(np.full((7, 4), 99.0), gi=0, n_groups=1, row_ranges=[(0, 7)])
+
+    sub = truth.formulas[:6]
+    ref = MSMBasicSearch(ds, sub, ds_config, sm_config).search().annotations
+    got = MSMBasicSearch(ds, sub, ds_config, sm_config,
+                         checkpoint_dir=str(tmp_path)).search().annotations
+    import pandas.testing as pdt
+
+    pdt.assert_frame_equal(got, ref)
+
+
 def test_search_deterministic(synthetic_ds):
     ds, truth = synthetic_ds
     sm_config = SMConfig.from_dict(
