@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.dataset import SpectralDataset
-from .quantize import quantize_mz
+from .quantize import MZ_PAD_Q, quantize_mz
 
 
 def prepare_cube_arrays(
@@ -107,6 +107,85 @@ def extract_images(
     gg = jnp.arange(g + 1, dtype=jnp.int32)[:, None]          # (G+1, 1)
     d = ((gg > r_lo[None, :]) & (gg <= r_hi[None, :])).astype(jnp.float32)
     img_pw = jnp.dot(wh, d, precision=jax.lax.Precision.HIGHEST)  # (P, W)
+    return img_pw.T
+
+
+# -- flat globally-sorted layout (single-device fast path) --------------------
+#
+# The padded cube pays for its padding: on the 64x64 bench workload the cube
+# is (4096, 896) = 3.7M slots for 1.17M real peaks, and the per-batch
+# ``searchsorted(..., method="sort")`` sorts ALL slots (47.8 ms measured on
+# v5e) while the scatter-add histograms them (38.6 ms) — together ~80% of the
+# fused graph.  Both shrink dramatically with a dataset-static GLOBALLY
+# m/z-sorted flat peak list:
+#
+# 1. Host, once per dataset: sort all peaks by quantized m/z ->
+#    (mz_sorted, pixel_sorted, int_sorted).
+# 2. Device, per batch: ``pos = searchsorted(mz_sorted, grid)`` — G=8K binary
+#    searches instead of a 3.7M-element sort — then every peak's grid bin
+#    falls out of ONE cumsum: bins[n] = #{g: grid[g] <= mz[n]} = inclusive
+#    cumsum of a delta array with +1 at each pos[g].  (Each bound's rank
+#    among the sorted peaks IS the count of peaks below it.)
+# 3. The histogram scatter-add touches only real peaks (1.17M, not 3.7M).
+# 4. The membership matmul is unchanged.
+#
+# Exactness: bins equal the cube path's ``searchsorted(grid, mz, 'right')``
+# by construction, the histogram sums the same (pixel, bin, intensity)
+# multiset of exact integers, and the matmul is identical — images are
+# bit-identical to the cube path (asserted in tests).  Measured: extraction
+# 94 ms -> ~20 ms per 1024-ion batch.
+
+
+def prepare_flat_sorted_arrays(
+    ds: SpectralDataset,
+    ppm: float,
+    pad_to_multiple: int = 1024,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host-side: globally m/z-sorted flat peak arrays
+    (mz_q (N,) int32 ascending, pixel (N,) int32, int (N,) f32 integer grid).
+
+    Padding: m/z saturates to the MZ_PAD_Q sentinel, pixel points at an
+    overflow row (``ds.n_pixels``, sliced off before the matmul), intensity 0.
+    """
+    mz_q = quantize_mz(ds.mzs_flat)
+    ints_q, _scale = ds.intensity_quantization(ppm)
+    lens = ds.row_lengths()
+    pixel = np.repeat(np.arange(ds.n_pixels, dtype=np.int32), lens)
+    order = np.argsort(mz_q, kind="stable")
+    n = int(mz_q.size)
+    n_pad = -(-max(n, 1) // pad_to_multiple) * pad_to_multiple
+    mz_s = np.full(n_pad, MZ_PAD_Q, dtype=np.int32)
+    px_s = np.full(n_pad, ds.n_pixels, dtype=np.int32)
+    in_s = np.zeros(n_pad, dtype=np.float32)
+    mz_s[:n] = mz_q[order]
+    px_s[:n] = pixel[order]
+    in_s[:n] = ints_q[order]
+    return mz_s, px_s, in_s
+
+
+def extract_images_flat(
+    mz_sorted: jnp.ndarray,     # (N,) int32 ascending, MZ_PAD_Q padding
+    pixel_sorted: jnp.ndarray,  # (N,) int32, n_pixels = overflow row
+    int_sorted: jnp.ndarray,    # (N,) f32, 0 at padding
+    grid: jnp.ndarray,          # (G,) int32 sorted window bounds
+    r_lo: jnp.ndarray,          # (W,) int32 leftmost rank of each lo bound
+    r_hi: jnp.ndarray,          # (W,) int32 leftmost rank of each hi bound
+    *,
+    n_pixels: int,
+) -> jnp.ndarray:
+    """(W, n_pixels) f32 ion-window images; bit-identical to extract_images."""
+    n = mz_sorted.shape[0]
+    g = grid.shape[0]
+    # pos[g] = #{peaks with mz < grid[g]} — G binary searches, not an N sort
+    pos = jnp.searchsorted(mz_sorted, grid, side="left")
+    # bins[j] = #{g: grid[g] <= mz[j]}: +1 at every pos, inclusive cumsum
+    delta = jnp.zeros(n + 1, jnp.int32).at[pos].add(1)
+    bins = jnp.cumsum(delta[:-1])
+    wh = jnp.zeros((n_pixels + 1, g + 1), jnp.float32).at[
+        pixel_sorted, bins].add(int_sorted)
+    gg = jnp.arange(g + 1, dtype=jnp.int32)[:, None]
+    d = ((gg > r_lo[None, :]) & (gg <= r_hi[None, :])).astype(jnp.float32)
+    img_pw = jnp.dot(wh[:n_pixels], d, precision=jax.lax.Precision.HIGHEST)
     return img_pw.T
 
 

@@ -197,9 +197,11 @@ class ShardedJaxBackend:
 
     def score_batches(self, tables) -> list[np.ndarray]:
         """Pipelined like the single-device backend: every batch enqueued
-        (async dispatch + sharded device_put) before any result is synced."""
-        pending = [self._dispatch(t) for t in tables]
-        return [np.asarray(out)[:n].astype(np.float64) for out, n in pending]
+        (async dispatch + sharded device_put) before any result is synced;
+        results fetched concurrently (models/msm_jax.fetch_scored_batches)."""
+        from ..models.msm_jax import fetch_scored_batches
+
+        return fetch_scored_batches([self._dispatch(t) for t in tables])
 
 
 def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConfig):
