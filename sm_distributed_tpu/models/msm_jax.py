@@ -22,7 +22,9 @@ from ..io.dataset import SpectralDataset
 from ..ops.imager_jax import (
     extract_images,
     extract_images_flat,
+    extract_images_flat_banded,
     extract_images_mz_chunked,
+    flat_bound_ranks,
     prepare_cube_arrays,
     prepare_flat_sorted_arrays,
     window_chunks,
@@ -33,6 +35,10 @@ from ..ops.metrics_jax import batch_metrics
 from ..ops.quantize import quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
+
+# windows per band chunk in the flat-banded extraction (each chunk's
+# membership matmul covers ~2*_BAND_WINDOWS grid columns)
+_BAND_WINDOWS = 512
 
 
 def fused_score_fn(
@@ -60,28 +66,32 @@ def fused_score_fn(
     )
 
 
-def fused_score_fn_flat(
-    mz_sorted: jnp.ndarray,    # (N,) int32 globally sorted
+def fused_score_fn_flat_banded(
     pixel_sorted: jnp.ndarray,  # (N,) int32
     int_sorted: jnp.ndarray,   # (N,) f32
-    grid: jnp.ndarray,
-    r_lo: jnp.ndarray,         # (B, K)
-    r_hi: jnp.ndarray,         # (B, K)
+    pos: jnp.ndarray,          # (G,) int32 host-computed bound ranks
+    starts: jnp.ndarray,       # (C,) chunk grid offsets
+    r_lo_loc: jnp.ndarray,     # (C, Wc)
+    r_hi_loc: jnp.ndarray,     # (C, Wc)
+    inv: jnp.ndarray,          # (B*K,)
     theor_ints: jnp.ndarray,
     n_valid: jnp.ndarray,
     *,
+    gc_width: int,
+    b: int,
+    k: int,
     nrows: int,
     ncols: int,
     nlevels: int,
     do_preprocessing: bool,
     q: float,
 ) -> jnp.ndarray:
-    """As fused_score_fn on the flat globally-sorted layout (bit-identical
-    images, ~5x faster extraction — see ops/imager_jax.py design note)."""
-    b, k = r_lo.shape
-    imgs = extract_images_flat(
-        mz_sorted, pixel_sorted, int_sorted, grid,
-        r_lo.ravel(), r_hi.ravel(), n_pixels=nrows * ncols)
+    """fused_score_fn_flat with the banded membership matmul — flops linear
+    in the batch, so large batches amortize the histogram scatter (see
+    ops/imager_jax.py::extract_images_flat_banded)."""
+    imgs = extract_images_flat_banded(
+        pixel_sorted, int_sorted, pos, starts, r_lo_loc, r_hi_loc, inv,
+        gc_width=gc_width, n_pixels=nrows * ncols)
     imgs = imgs.reshape(b, k, -1)
     return batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
@@ -181,27 +191,34 @@ class JaxBackend:
                 static_argnames=("gc_width", "b", "k"),
             )
         else:
-            # flat globally-sorted layout: no padding slots, per-batch bins
-            # via G binary searches + one cumsum (see ops/imager_jax.py)
+            # flat globally-sorted layout: no padding slots; per-batch bound
+            # ranks computed ON HOST against the host copy of the sorted m/z
+            # array and shipped as (G,) int32 (see ops/imager_jax.py)
             mz_s, px_s, in_s = prepare_flat_sorted_arrays(ds, self.ppm)
-            self._mz_s = jax.device_put(mz_s)
+            self._mz_host = mz_s
             self._px_s = jax.device_put(px_s)
             self._in_s = jax.device_put(in_s)
             logger.info(
                 "jax_tpu flat peaks resident: %d sorted peaks (%.1f MB) on %s",
-                mz_s.size, mz_s.nbytes * 3 / 1e6, self._mz_s.devices(),
+                mz_s.size, (px_s.nbytes + in_s.nbytes) / 1e6,
+                self._px_s.devices(),
             )
-            self._fn = jax.jit(partial(fused_score_fn_flat, **common))
+            self._fn = jax.jit(
+                partial(fused_score_fn_flat_banded, **common),
+                static_argnames=("gc_width", "b", "k"))
+            # sticky band width: grows to the max seen so one executable
+            # serves (almost) all batches instead of recompiling per batch
+            self._gc_width = 0
 
-    def _dispatch(self, table: IsotopePatternTable):
-        """Async: enqueue one padded batch on device, return (device_out, n)."""
-        n = table.n_ions
-        b = self.batch
+    def _padded_windows(self, table: IsotopePatternTable):
+        """Pad one batch's quantized windows to the static batch size
+        (padded ions: bounds (0, 0), n_valid=0 -> all metrics 0) and rank
+        the bounds: (grid, r_lo, r_hi, ints_p, nv_p)."""
+        n, b = table.n_ions, self.batch
         if n > b:
             raise ValueError(f"batch of {n} ions exceeds formula_batch={b}")
         k = table.max_peaks
         lo_q, hi_q = quantize_window(table.mzs, self.ppm)
-        # pad to the static batch size (padded ions: n_valid=0 -> all metrics 0)
         lo_p = np.zeros((b, k), dtype=np.int32)
         hi_p = np.zeros((b, k), dtype=np.int32)
         ints_p = np.zeros((b, k), dtype=np.float32)
@@ -210,9 +227,23 @@ class JaxBackend:
         ints_p[:n] = table.ints
         nv_p[:n] = table.n_valid
         grid, r_lo, r_hi = window_rank_grid(lo_p, hi_p)
+        return grid, r_lo, r_hi, ints_p, nv_p
+
+    def _flat_plan(self, table: IsotopePatternTable):
+        """Host prep of one batch for the flat-banded path: padded windows +
+        the window-chunk plan.  Computed once per table (score_batches builds
+        the plans up front to pre-size the band, then reuses them)."""
+        grid, r_lo, r_hi, ints_p, nv_p = self._padded_windows(table)
+        return (grid, r_lo, r_hi, ints_p, nv_p,
+                window_chunks(r_lo, r_hi, _BAND_WINDOWS))
+
+    def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
+        """Async: enqueue one padded batch on device, return (device_out, n)."""
+        n, b, k = table.n_ions, self.batch, table.max_peaks
         # explicit async device_put: the transfers overlap device compute of
         # previously enqueued batches instead of blocking the dispatch path
         if self.mz_chunk:
+            grid, r_lo, r_hi, ints_p, nv_p = self._padded_windows(table)
             starts, r_lo_loc, r_hi_loc, inv, gc_width = window_chunks(
                 r_lo, r_hi, self.mz_chunk)
             args = [jax.device_put(a) for a in (
@@ -220,9 +251,16 @@ class JaxBackend:
             out = self._fn(self._mz_q, self._ints, *args,
                            gc_width=gc_width, b=b, k=k)
         else:
+            if flat_plan is None:
+                flat_plan = self._flat_plan(table)
+            grid, _r_lo, _r_hi, ints_p, nv_p, chunks = flat_plan
+            starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
+            self._gc_width = max(self._gc_width, gc_width)
+            pos = flat_bound_ranks(self._mz_host, grid)
             args = [jax.device_put(a) for a in (
-                grid, r_lo.reshape(b, k), r_hi.reshape(b, k), ints_p, nv_p)]
-            out = self._fn(self._mz_s, self._px_s, self._in_s, *args)
+                pos, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
+            out = self._fn(self._px_s, self._in_s, *args,
+                           gc_width=self._gc_width, b=b, k=k)
         return out, n
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
@@ -246,11 +284,7 @@ class JaxBackend:
                 for s in range(0, n, b)
             ])
         k = table.max_peaks
-        lo_q, hi_q = quantize_window(table.mzs, self.ppm)
-        lo_p = np.zeros((b, k), dtype=np.int32)
-        hi_p = np.zeros((b, k), dtype=np.int32)
-        lo_p[:n], hi_p[:n] = lo_q, hi_q
-        grid, r_lo, r_hi = window_rank_grid(lo_p, hi_p)
+        grid, r_lo, r_hi, _ints, _nv = self._padded_windows(table)
         if self.mz_chunk:
             if not hasattr(self, "_extract_fn"):
                 self._extract_fn = jax.jit(extract_images)
@@ -261,8 +295,9 @@ class JaxBackend:
             if not hasattr(self, "_extract_fn"):
                 self._extract_fn = jax.jit(
                     partial(extract_images_flat, n_pixels=self.ds.n_pixels))
+            pos = flat_bound_ranks(self._mz_host, grid)
             imgs = self._extract_fn(
-                self._mz_s, self._px_s, self._in_s, jax.device_put(grid),
+                self._px_s, self._in_s, jax.device_put(pos),
                 jax.device_put(r_lo), jax.device_put(r_hi))
         imgs = np.array(imgs).reshape(b, k, -1)[:n, :, : self.ds.n_pixels]
         imgs /= np.float32(self.int_scale)  # exact power-of-two division
@@ -276,4 +311,15 @@ class JaxBackend:
         """Pipelined scoring: enqueue every batch before syncing any result
         (JAX dispatch is async, so device compute of all batches overlaps the
         ~0.3 ms/batch host prep), then fetch all results concurrently."""
-        return fetch_scored_batches([self._dispatch(t) for t in tables])
+        tables = list(tables)
+        if self.mz_chunk:
+            return fetch_scored_batches([self._dispatch(t) for t in tables])
+        # plan every batch up front: pre-sizes the band to the stream's max
+        # so ONE executable serves every batch (a mid-stream gc_width growth
+        # would recompile, ~15 s through a tunneled TPU), and each plan is
+        # reused by its dispatch instead of recomputed
+        plans = [self._flat_plan(t) for t in tables]
+        for plan in plans:
+            self._gc_width = max(self._gc_width, plan[5][4])
+        return fetch_scored_batches(
+            [self._dispatch(t, plan) for t, plan in zip(tables, plans)])

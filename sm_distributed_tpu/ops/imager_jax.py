@@ -163,22 +163,32 @@ def prepare_flat_sorted_arrays(
     return mz_s, px_s, in_s
 
 
+def flat_bound_ranks(mz_sorted_host: np.ndarray, grid: np.ndarray) -> np.ndarray:
+    """Host-side per-batch: rank of each grid bound among the sorted peaks,
+    ``pos[g] = #{peaks with mz < grid[g]}``.  G binary searches into the
+    host copy of the dataset-static sorted m/z array — sub-millisecond,
+    replacing a ~10 ms device searchsorted; ships as (G,) int32 (32 KB).
+    (Shipping the full per-peak bins array instead was tried: host cumsum is
+    free but the N-sized uint16 transfer (~5 MB/batch) is slower through a
+    tunneled TPU than the device cumsum it saves.)"""
+    return np.searchsorted(mz_sorted_host, grid, side="left").astype(np.int32)
+
+
 def extract_images_flat(
-    mz_sorted: jnp.ndarray,     # (N,) int32 ascending, MZ_PAD_Q padding
     pixel_sorted: jnp.ndarray,  # (N,) int32, n_pixels = overflow row
     int_sorted: jnp.ndarray,    # (N,) f32, 0 at padding
-    grid: jnp.ndarray,          # (G,) int32 sorted window bounds
+    pos: jnp.ndarray,           # (G,) int32 host-computed bound ranks
     r_lo: jnp.ndarray,          # (W,) int32 leftmost rank of each lo bound
     r_hi: jnp.ndarray,          # (W,) int32 leftmost rank of each hi bound
     *,
     n_pixels: int,
 ) -> jnp.ndarray:
-    """(W, n_pixels) f32 ion-window images; bit-identical to extract_images."""
-    n = mz_sorted.shape[0]
-    g = grid.shape[0]
-    # pos[g] = #{peaks with mz < grid[g]} — G binary searches, not an N sort
-    pos = jnp.searchsorted(mz_sorted, grid, side="left")
-    # bins[j] = #{g: grid[g] <= mz[j]}: +1 at every pos, inclusive cumsum
+    """(W, n_pixels) f32 ion-window images; bit-identical to extract_images.
+
+    ``bins[j] = #{g: grid[g] <= mz[j]}`` == #bounds whose rank is <= j:
+    +1 at every pos, one inclusive cumsum."""
+    n = pixel_sorted.shape[0]
+    g = pos.shape[0]
     delta = jnp.zeros(n + 1, jnp.int32).at[pos].add(1)
     bins = jnp.cumsum(delta[:-1])
     wh = jnp.zeros((n_pixels + 1, g + 1), jnp.float32).at[
@@ -187,6 +197,55 @@ def extract_images_flat(
     d = ((gg > r_lo[None, :]) & (gg <= r_hi[None, :])).astype(jnp.float32)
     img_pw = jnp.dot(wh[:n_pixels], d, precision=jax.lax.Precision.HIGHEST)
     return img_pw.T
+
+
+def extract_images_flat_banded(
+    pixel_sorted: jnp.ndarray,  # (N,) int32, n_pixels = overflow row
+    int_sorted: jnp.ndarray,    # (N,) f32, 0 at padding
+    pos: jnp.ndarray,           # (G,) int32 host-computed bound ranks
+    starts: jnp.ndarray,        # (C,) int32 chunk grid offsets (window_chunks)
+    r_lo_loc: jnp.ndarray,      # (C, Wc) int32 local lo ranks
+    r_hi_loc: jnp.ndarray,      # (C, Wc) int32 local hi ranks
+    inv: jnp.ndarray,           # (W,) int32 sorted-row -> input-order map
+    *,
+    gc_width: int,
+    n_pixels: int,
+) -> jnp.ndarray:
+    """(W, n_pixels) flat extraction with a BANDED membership matmul.
+
+    The dense membership matrix costs 2*P*(G+1)*W flops — quadratic in the
+    batch size (G and W both scale with B*K), which is what forbids large
+    batches even though the histogram scatter amortizes with B.  But each
+    window's bins live in the narrow band (r_lo, r_hi] of the grid, so with
+    windows m/z-sorted and chunked (the ``window_chunks`` plan), chunk c's
+    512 windows only need grid columns [start_c, start_c + gc_width + 2):
+    flops drop to 2*P*gc*W — LINEAR in the batch.  The histogram is built
+    ONCE at full width (its cost is per-peak, not per-window), then each
+    chunk dynamic-slices its band and runs a small MXU matmul.  Images are
+    bit-identical: out-of-band bins have zero membership in the dense form.
+    """
+    n = pixel_sorted.shape[0]
+    g = pos.shape[0]
+    delta = jnp.zeros(n + 1, jnp.int32).at[pos].add(1)
+    bins = jnp.cumsum(delta[:-1])
+    # extra zero columns so the last chunk's band slice stays in range
+    # (dynamic_slice would otherwise clamp the start and misalign ranks)
+    wh = jnp.zeros((n_pixels + 1, g + 1 + gc_width + 2), jnp.float32).at[
+        pixel_sorted, bins].add(int_sorted)
+    whp = wh[:n_pixels]
+    gg = jnp.arange(gc_width + 2, dtype=jnp.int32)[:, None]
+
+    def chunk(_, data):
+        start, rlo, rhi = data
+        band = jax.lax.dynamic_slice(
+            whp, (jnp.int32(0), start), (n_pixels, gc_width + 2))
+        d = ((gg > rlo[None, :]) & (gg <= rhi[None, :])).astype(jnp.float32)
+        return None, jnp.dot(
+            band, d, precision=jax.lax.Precision.HIGHEST).T
+
+    _, imgs = jax.lax.scan(chunk, None, (starts, r_lo_loc, r_hi_loc))
+    imgs = imgs.reshape(-1, n_pixels)                  # (C*Wc, P) sorted order
+    return jnp.take(imgs, inv, axis=0)                 # (W, P) input order
 
 
 # -- m/z-chunked extraction ---------------------------------------------------
@@ -218,7 +277,15 @@ def window_chunks(
     w = int(r_lo.size)
     wc = max(1, int(mz_chunk))
     c = max(1, -(-w // wc))
-    order = np.argsort(r_lo, kind="stable")
+    # EMPTY windows (lo == hi: batch padding quantized to (0,0), or windows
+    # collapsed by quantization) sort LAST, not by their rank-0 bounds —
+    # otherwise a partially-padded batch puts rank-0 empties and high-rank
+    # real windows into one chunk whose span is the whole grid, and the
+    # sticky gc_width then degrades every batch (measured: 8x band growth,
+    # ~10x slowdown on the bench tail batch).  Their local ranks go
+    # negative in a straddling chunk, which the membership test treats as
+    # empty — exactly right.
+    order = np.lexsort((r_lo, (r_lo == r_hi).astype(np.int8)))
     pad = c * wc - w
     r_lo_s = np.concatenate([r_lo[order], np.zeros(pad, r_lo.dtype)]).reshape(c, wc)
     r_hi_s = np.concatenate([r_hi[order], np.zeros(pad, r_hi.dtype)]).reshape(c, wc)

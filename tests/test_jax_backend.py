@@ -130,8 +130,8 @@ def test_extraction_flat_bit_identical_to_cube(fixture_ds):
     exact-integer sums."""
     import jax.numpy as jnp
     from sm_distributed_tpu.ops.imager_jax import (
-        extract_images, extract_images_flat, prepare_cube_arrays,
-        prepare_flat_sorted_arrays, window_rank_grid,
+        extract_images, extract_images_flat, flat_bound_ranks,
+        prepare_cube_arrays, prepare_flat_sorted_arrays, window_rank_grid,
     )
     from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
     from sm_distributed_tpu.ops.quantize import quantize_window
@@ -150,9 +150,11 @@ def test_extraction_flat_bit_identical_to_cube(fixture_ds):
     )[:, : ds.n_pixels]
 
     mz_s, px_s, in_s = prepare_flat_sorted_arrays(ds, 3.0)
+    # host-computed bound ranks == the cube path's device-side searchsorted
+    pos = flat_bound_ranks(mz_s, grid)
     flat = np.asarray(
-        extract_images_flat(jnp.asarray(mz_s), jnp.asarray(px_s),
-                            jnp.asarray(in_s), jnp.asarray(grid),
+        extract_images_flat(jnp.asarray(px_s), jnp.asarray(in_s),
+                            jnp.asarray(pos),
                             jnp.asarray(r_lo), jnp.asarray(r_hi),
                             n_pixels=ds.n_pixels)
     )
