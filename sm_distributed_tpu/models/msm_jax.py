@@ -161,8 +161,11 @@ class JaxBackend:
     name = "jax_tpu"
 
     def __init__(self, ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConfig):
+        from ..parallel.distributed import enable_compile_cache
+
         self.ds = ds
         self.ds_config = ds_config
+        enable_compile_cache(sm_config)
         self.batch = max(1, sm_config.parallel.formula_batch)
         img_cfg = ds_config.image_generation
         self.ppm = img_cfg.ppm

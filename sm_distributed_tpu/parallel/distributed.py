@@ -29,6 +29,23 @@ from ..utils.logger import logger
 _initialized = False
 
 
+def enable_compile_cache(sm_config) -> None:
+    """Point XLA's persistent compilation cache at a work-dir subdirectory
+    so a dataset's second job (same shapes) skips the compile entirely —
+    measured 15-20 s per dataset on a tunneled v5e, ~0.1 s warm.  ``"off"``
+    disables; idempotent (jax.config.update is)."""
+    d = sm_config.parallel.compile_cache_dir
+    if d == "off":
+        return
+    from pathlib import Path
+
+    import jax
+
+    path = d or str(Path(sm_config.work_dir) / "xla_cache")
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
 def resolve_distributed_settings(cfg: ParallelConfig) -> tuple[str, int, int]:
     """(coordinator, num_processes, process_id) from env (priority) or cfg."""
     coord = os.environ.get("SM_COORDINATOR", cfg.coordinator_address)

@@ -123,8 +123,11 @@ class ShardedJaxBackend:
         sm_config: SMConfig,
         mesh: Mesh | None = None,
     ):
+        from .distributed import enable_compile_cache
+
         self.ds = ds
         self.ds_config = ds_config
+        enable_compile_cache(sm_config)
         self.mesh = mesh if mesh is not None else make_mesh(sm_config.parallel)
         n_pix_shards = self.mesh.shape[PIXELS_AXIS]
         n_form_shards = self.mesh.shape[FORMULAS_AXIS]

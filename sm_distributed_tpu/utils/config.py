@@ -28,6 +28,9 @@ def _from_dict(cls, d: dict[str, Any]):
     """Build a dataclass from a dict, recursing into dataclass fields and
     rejecting unknown keys (catches config typos early, unlike the reference's
     raw-dict access which fails deep inside a Spark task)."""
+    # "__doc__"-style keys are comments (JSON has none; the shipped
+    # conf/*.template files use them), skipped by load & validation
+    d = {k: v for k, v in d.items() if not k.startswith("__")}
     names = {f.name for f in dataclasses.fields(cls)}
     unknown = set(d) - names
     if unknown:
@@ -122,6 +125,10 @@ class ParallelConfig:
     # formula batches; 0 disables.  A killed multi-hour search (BASELINE
     # configs #3/#5) resumes from the last complete group.
     checkpoint_every: int = 0
+    # persistent XLA compilation cache: "" = <work_dir>/xla_cache (repeat
+    # datasets with the same shapes skip the ~15-20s TPU compile entirely),
+    # "off" = disabled, anything else = explicit directory
+    compile_cache_dir: str = ""
 
 
 @dataclass(frozen=True)

@@ -95,6 +95,25 @@ def test_config_tuple_coercion():
     hash(ds.isotope_generation)  # frozen config stays hashable
 
 
+def test_shipped_config_templates_load():
+    """conf/*.template must parse to pure-default configs (reference ships
+    conf/config.json.template [U], SURVEY #20); ``__doc__`` comment keys are
+    skipped by validation."""
+    from pathlib import Path
+
+    from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+    conf = Path(__file__).parent.parent / "conf"
+    import json
+
+    sm = SMConfig.from_dict(
+        json.loads((conf / "config.json.template").read_text()))
+    assert sm == SMConfig()
+    ds = DSConfig.from_dict(
+        json.loads((conf / "ds_config.json.template").read_text()))
+    assert ds == DSConfig()
+
+
 def test_isotope_table_sane():
     # Abundances sum to ~1, masses ascending, for every element.
     for el, isos in elements.ISOTOPES.items():
