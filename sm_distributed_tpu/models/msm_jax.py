@@ -20,6 +20,9 @@ import numpy as np
 
 from ..io.dataset import SpectralDataset
 from ..ops.imager_jax import (
+    BAND_WINDOWS as _BAND_WINDOWS,
+)
+from ..ops.imager_jax import (
     extract_images,
     extract_images_flat,
     extract_images_flat_banded,
@@ -35,10 +38,6 @@ from ..ops.metrics_jax import batch_metrics
 from ..ops.quantize import quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
-
-# windows per band chunk in the flat-banded extraction (each chunk's
-# membership matmul covers ~2*_BAND_WINDOWS grid columns)
-_BAND_WINDOWS = 512
 
 
 def fused_score_fn(

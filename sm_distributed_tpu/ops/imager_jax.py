@@ -39,6 +39,10 @@ import numpy as np
 from ..io.dataset import SpectralDataset
 from .quantize import MZ_PAD_Q, quantize_mz
 
+# windows per band chunk in the flat-banded extraction (each chunk's
+# membership matmul covers ~2*BAND_WINDOWS grid columns)
+BAND_WINDOWS = 512
+
 
 def prepare_cube_arrays(
     ds: SpectralDataset,
@@ -146,21 +150,11 @@ def prepare_flat_sorted_arrays(
 
     Padding: m/z saturates to the MZ_PAD_Q sentinel, pixel points at an
     overflow row (``ds.n_pixels``, sliced off before the matmul), intensity 0.
+    The single-device layout IS the 1-shard case of the sharded builder.
     """
-    mz_q = quantize_mz(ds.mzs_flat)
-    ints_q, _scale = ds.intensity_quantization(ppm)
-    lens = ds.row_lengths()
-    pixel = np.repeat(np.arange(ds.n_pixels, dtype=np.int32), lens)
-    order = np.argsort(mz_q, kind="stable")
-    n = int(mz_q.size)
-    n_pad = -(-max(n, 1) // pad_to_multiple) * pad_to_multiple
-    mz_s = np.full(n_pad, MZ_PAD_Q, dtype=np.int32)
-    px_s = np.full(n_pad, ds.n_pixels, dtype=np.int32)
-    in_s = np.zeros(n_pad, dtype=np.float32)
-    mz_s[:n] = mz_q[order]
-    px_s[:n] = pixel[order]
-    in_s[:n] = ints_q[order]
-    return mz_s, px_s, in_s
+    mz_s, px_s, in_s, _p_loc = prepare_flat_sharded_arrays(
+        ds, ppm, n_shards=1, pad_to_multiple=pad_to_multiple)
+    return mz_s[0], px_s[0], in_s[0]
 
 
 def flat_bound_ranks(mz_sorted_host: np.ndarray, grid: np.ndarray) -> np.ndarray:
@@ -246,6 +240,45 @@ def extract_images_flat_banded(
     _, imgs = jax.lax.scan(chunk, None, (starts, r_lo_loc, r_hi_loc))
     imgs = imgs.reshape(-1, n_pixels)                  # (C*Wc, P) sorted order
     return jnp.take(imgs, inv, axis=0)                 # (W, P) input order
+
+
+def prepare_flat_sharded_arrays(
+    ds: SpectralDataset,
+    ppm: float,
+    n_shards: int,
+    pad_to_multiple: int = 1024,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Host-side flat layout per PIXEL SHARD: (mz_q (S, Nmax) int32 ascending
+    per row, px_local (S, Nmax) int32, ints (S, Nmax) f32, p_loc).
+
+    Each shard owns a contiguous slice of ``p_loc = ceil(P/S)`` pixels and
+    its peaks sorted by quantized m/z; rows pad to the max shard peak count
+    (m/z -> MZ_PAD_Q sentinel, pixel -> the shard-local overflow row
+    ``p_loc``, intensity 0).  Unlike the padded cube — whose row length is
+    the MAX spectrum length, catastrophic for ragged DESI data — per-shard
+    bytes track the actual peak count.  The m/z rows stay host-side (bound
+    ranks are host-computed); only pixel + intensity rows go to HBM.
+    """
+    p_pad = -(-ds.n_pixels // n_shards) * n_shards
+    p_loc = p_pad // n_shards
+    mz_q = quantize_mz(ds.mzs_flat)
+    ints_q, _scale = ds.intensity_quantization(ppm)
+    lens = ds.row_lengths()
+    pixel = np.repeat(np.arange(ds.n_pixels, dtype=np.int64), lens)
+    shard = (pixel // p_loc).astype(np.int32)
+    counts = np.bincount(shard, minlength=n_shards)
+    n_max = -(-max(int(counts.max()), 1) // pad_to_multiple) * pad_to_multiple
+    mz_s = np.full((n_shards, n_max), MZ_PAD_Q, dtype=np.int32)
+    px_s = np.full((n_shards, n_max), p_loc, dtype=np.int32)
+    in_s = np.zeros((n_shards, n_max), dtype=np.float32)
+    for s in range(n_shards):
+        m = shard == s
+        order = np.argsort(mz_q[m], kind="stable")
+        c = int(counts[s])
+        mz_s[s, :c] = mz_q[m][order]
+        px_s[s, :c] = (pixel[m] - s * p_loc).astype(np.int32)[order]
+        in_s[s, :c] = ints_q[m][order]
+    return mz_s, px_s, in_s, p_loc
 
 
 # -- m/z-chunked extraction ---------------------------------------------------

@@ -5,7 +5,7 @@
 """
 
 from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh, resolve_axis_sizes
-from .sharded import ShardedJaxBackend, build_sharded_score_fn, make_jax_backend
+from .sharded import ShardedJaxBackend, build_sharded_score_factory, make_jax_backend
 
 __all__ = [
     "FORMULAS_AXIS",
@@ -13,6 +13,6 @@ __all__ = [
     "make_mesh",
     "resolve_axis_sizes",
     "ShardedJaxBackend",
-    "build_sharded_score_fn",
+    "build_sharded_score_factory",
     "make_jax_backend",
 ]

@@ -5,8 +5,12 @@ TPU-native replacement for the reference's distributed runtime (SURVEY.md
 ``groupByKey`` shuffle of (ion, pixel, intensity) hits
 (``formula_imager_segm.compute_sf_images`` [U], §3.3), here:
 
-- the spectral cube is resident in HBM, sharded over the ``"pixels"`` mesh
-  axis (``NamedSharding(mesh, P("pixels", None))``) — the RDD-partition analog;
+- the spectral data is resident in HBM as per-pixel-shard FLAT sorted peak
+  lists sharded over the ``"pixels"`` mesh axis — the RDD-partition analog.
+  (Round-2 switch from the padded cube: per-shard bytes track the actual
+  peak count instead of pixels x max-spectrum-length, which is what a
+  ragged >200k-pixel DESI slide needs, and extraction uses the same
+  flat-banded kernel as the single-device path);
 - the isotope window/intensity tables are sharded over ``"formulas"`` and
   replicated over ``"pixels"`` — the broadcast analog (XLA materializes it as
   an all-gather over ICI);
@@ -35,7 +39,16 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..io.dataset import SpectralDataset
-from ..ops.imager_jax import extract_images, prepare_cube_arrays, window_rank_grid
+from ..ops.imager_jax import (
+    BAND_WINDOWS as _BAND_WINDOWS,
+)
+from ..ops.imager_jax import (
+    extract_images_flat_banded,
+    flat_bound_ranks,
+    prepare_flat_sharded_arrays,
+    window_chunks,
+    window_rank_grid,
+)
 from ..ops.isocalc import IsotopePatternTable
 from ..ops.metrics_jax import batch_metrics
 from ..ops.quantize import quantize_window
@@ -44,29 +57,40 @@ from ..utils.logger import logger
 from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh
 
 
-def build_sharded_score_fn(
+def build_sharded_score_factory(
     mesh: Mesh,
     *,
+    p_loc: int,
     nrows: int,
     ncols: int,
     nlevels: int,
     do_preprocessing: bool,
     q: float,
 ):
-    """Jitted sharded step: (cube shards, window shards) -> (B, 4) metrics.
+    """Returns ``make(gc_width) -> jitted sharded step``: the step maps
+    (flat peak shards, window shards) -> (B, 4) metrics; the factory exists
+    because the band width is a static shape (ShardedJaxBackend caches one
+    executable per gc_width, normally exactly one thanks to the sticky
+    pre-sized band).
 
-    Layouts: mz_q_cube/int_cube sharded P("pixels", None); the window-bound
-    grid + ranks are built per formula shard on host (each shard histograms
-    only its own windows' bounds) and sharded P("formulas", ...); output
-    sharded P("formulas", None).
+    Layouts: the flat peak arrays (pixel + intensity rows, one row per pixel
+    shard) are sharded P("pixels", None); the per-(pixel-shard x formula-
+    shard) bound ranks P("pixels", "formulas"); the window-chunk plan per
+    formula shard P("formulas", ...); output P("formulas", None).  The
+    extraction inside each device block is exactly the single-device
+    flat-banded kernel on the shard's pixel slice.
     """
 
     n_pix = mesh.shape[PIXELS_AXIS]
 
-    def step(mz_q_cube, int_cube, grid, r_lo, r_hi, theor_ints, n_valid):
-        # Per-device block: cube (P_loc, L); windows (B_loc, K); grid (G_loc,).
-        b, k = r_lo.shape
-        imgs_loc = extract_images(mz_q_cube, int_cube, grid, r_lo.ravel(), r_hi.ravel())
+    def step(px_s, in_s, pos, starts, r_lo_loc, r_hi_loc, inv,
+             theor_ints, n_valid, *, gc_width):
+        # Per-device blocks: px_s/in_s (1, Nmax); pos (1, G_loc); plan
+        # (C, Wc)/(C,)/(W_loc,); theor (B_loc, K); n_valid (B_loc,).
+        b, k = theor_ints.shape
+        imgs_loc = extract_images_flat_banded(
+            px_s[0], in_s[0], pos[0], starts, r_lo_loc, r_hi_loc, inv,
+            gc_width=gc_width, n_pixels=p_loc)
         imgs_loc = imgs_loc.reshape(b, k, -1)            # (B_loc, K, P_loc)
         # The "shuffle": trade pixel slices for full-pixel ion sub-batches.
         # Device j of the pixel group ends with (B_loc/n_pix, K, P_full).
@@ -84,26 +108,33 @@ def build_sharded_score_fn(
         # order, matching the original ion order)
         return jax.lax.all_gather(out_mine, PIXELS_AXIS, axis=0, tiled=True)
 
-    sharded = jax.shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(
-            P(PIXELS_AXIS, None),      # mz_q_cube
-            P(PIXELS_AXIS, None),      # int_cube
-            P(FORMULAS_AXIS),          # grid (concatenated per-shard grids)
-            P(FORMULAS_AXIS, None),    # r_lo
-            P(FORMULAS_AXIS, None),    # r_hi
-            P(FORMULAS_AXIS, None),    # theor_ints
-            P(FORMULAS_AXIS),          # n_valid
-        ),
-        out_specs=P(FORMULAS_AXIS, None),
-        # The output IS replicated over "pixels" (tiled all_gather of the
-        # per-shard metric rows).  JAX's VMA type system can't infer
-        # replication through tiled all_gather (no all_gather_invariant in
-        # jax 0.9), so the static check is disabled.
-        check_vma=False,
-    )
-    return jax.jit(sharded)
+    def make(gc_width):
+        from functools import partial
+
+        sharded = jax.shard_map(
+            partial(step, gc_width=gc_width),
+            mesh=mesh,
+            in_specs=(
+                P(PIXELS_AXIS, None),             # px_s (S, Nmax)
+                P(PIXELS_AXIS, None),             # in_s (S, Nmax)
+                P(PIXELS_AXIS, FORMULAS_AXIS),    # pos (S, F*G_loc)
+                P(FORMULAS_AXIS),                 # starts (F*C,)
+                P(FORMULAS_AXIS, None),           # r_lo_loc (F*C, Wc)
+                P(FORMULAS_AXIS, None),           # r_hi_loc (F*C, Wc)
+                P(FORMULAS_AXIS),                 # inv (F*W_loc,)
+                P(FORMULAS_AXIS, None),           # theor_ints
+                P(FORMULAS_AXIS),                 # n_valid
+            ),
+            out_specs=P(FORMULAS_AXIS, None),
+            # The output IS replicated over "pixels" (tiled all_gather of the
+            # per-shard metric rows).  JAX's VMA type system can't infer
+            # replication through tiled all_gather (no all_gather_invariant
+            # in jax 0.9), so the static check is disabled.
+            check_vma=False,
+        )
+        return jax.jit(sharded)
+
+    return make
 
 
 def _round_up(n: int, m: int) -> int:
@@ -139,30 +170,39 @@ class ShardedJaxBackend:
         img_cfg = ds_config.image_generation
         self.ppm = img_cfg.ppm
 
-        mz_q, int_cube = prepare_cube_arrays(
-            ds, pixels_multiple=n_pix_shards, ppm=self.ppm)
+        mz_s, px_s, in_s, self._p_loc = prepare_flat_sharded_arrays(
+            ds, self.ppm, n_pix_shards)
         self.int_scale = ds.intensity_quantization(self.ppm)[1]
-        cube_sharding = NamedSharding(self.mesh, P(PIXELS_AXIS, None))
-        self._mz_q = jax.device_put(mz_q, cube_sharding)
-        self._ints = jax.device_put(int_cube, cube_sharding)
+        flat_sharding = NamedSharding(self.mesh, P(PIXELS_AXIS, None))
+        self._mz_shards = mz_s                 # host-side, for bound ranks
+        self._px_s = jax.device_put(px_s, flat_sharding)
+        self._in_s = jax.device_put(in_s, flat_sharding)
+        self._pos_sharding = NamedSharding(
+            self.mesh, P(PIXELS_AXIS, FORMULAS_AXIS))
         self._form_sharding = NamedSharding(self.mesh, P(FORMULAS_AXIS, None))
         self._nv_sharding = NamedSharding(self.mesh, P(FORMULAS_AXIS))
         self._n_form_shards = n_form_shards
         logger.info(
-            "jax_tpu sharded cube resident: %s over mesh %s (pixels=%d, formulas=%d)",
-            mz_q.shape, dict(self.mesh.shape), n_pix_shards, n_form_shards,
+            "jax_tpu sharded flat peaks resident: %s over mesh %s "
+            "(pixels=%d, formulas=%d, p_loc=%d)",
+            px_s.shape, dict(self.mesh.shape), n_pix_shards, n_form_shards,
+            self._p_loc,
         )
-        self._fn = build_sharded_score_fn(
+        self._make_fn = build_sharded_score_factory(
             self.mesh,
+            p_loc=self._p_loc,
             nrows=ds.nrows,
             ncols=ds.ncols,
             nlevels=img_cfg.nlevels,
             do_preprocessing=img_cfg.do_preprocessing,
             q=img_cfg.q,
         )
+        self._fns: dict[int, object] = {}      # gc_width -> jitted step
+        self._gc_width = 0                     # sticky (see JaxBackend)
 
-    def _dispatch(self, table: IsotopePatternTable):
-        """Async: enqueue one padded sharded batch, return (device_out, n)."""
+    def _flat_plan(self, table: IsotopePatternTable):
+        """Host prep: per-formula-shard bound grids + chunk plans + the
+        per-(pixel-shard, formula-shard) bound ranks."""
         n = table.n_ions
         b = self.batch
         if n > b:
@@ -179,20 +219,45 @@ class ShardedJaxBackend:
         # Per-formula-shard bound grids: shard f histograms only its windows.
         f = self._n_form_shards
         b_loc = b // f
-        grids, r_los, r_his = [], [], []
+        poss, starts_l, rlo_l, rhi_l, invs, gc = [], [], [], [], [], 0
         for s in range(f):
             sl = slice(s * b_loc, (s + 1) * b_loc)
-            g, rl, rh = window_rank_grid(lo_p[sl], hi_p[sl])
-            grids.append(g)
-            r_los.append(rl.reshape(b_loc, k))
-            r_his.append(rh.reshape(b_loc, k))
-        grid_d = jax.device_put(np.concatenate(grids), self._nv_sharding)
-        rlo_d = jax.device_put(np.concatenate(r_los), self._form_sharding)
-        rhi_d = jax.device_put(np.concatenate(r_his), self._form_sharding)
+            grid, rl, rh = window_rank_grid(lo_p[sl], hi_p[sl])
+            st, rll, rhl, inv, gcs = window_chunks(rl, rh, _BAND_WINDOWS)
+            gc = max(gc, gcs)
+            starts_l.append(st)
+            rlo_l.append(rll)
+            rhi_l.append(rhl)
+            invs.append(inv)
+            # ranks of this formula shard's bounds in EVERY pixel shard's
+            # sorted peaks: (S, G_loc)
+            poss.append(np.stack([
+                flat_bound_ranks(self._mz_shards[px], grid)
+                for px in range(self._mz_shards.shape[0])
+            ]))
+        return (np.concatenate(poss, axis=1), np.concatenate(starts_l),
+                np.concatenate(rlo_l), np.concatenate(rhi_l),
+                np.concatenate(invs), ints_p, nv_p, gc)
+
+    def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
+        """Async: enqueue one padded sharded batch, return (device_out, n)."""
+        if flat_plan is None:
+            flat_plan = self._flat_plan(table)
+        pos, starts, rlo, rhi, inv, ints_p, nv_p, gc = flat_plan
+        self._gc_width = max(self._gc_width, gc)
+        gc = self._gc_width
+        if gc not in self._fns:
+            self._fns[gc] = self._make_fn(gc)
+        pos_d = jax.device_put(pos, self._pos_sharding)
+        starts_d = jax.device_put(starts, self._nv_sharding)
+        rlo_d = jax.device_put(rlo, self._form_sharding)
+        rhi_d = jax.device_put(rhi, self._form_sharding)
+        inv_d = jax.device_put(inv, self._nv_sharding)
         ints_d = jax.device_put(ints_p, self._form_sharding)
         nv_d = jax.device_put(nv_p, self._nv_sharding)
-        out = self._fn(self._mz_q, self._ints, grid_d, rlo_d, rhi_d, ints_d, nv_d)
-        return out, n
+        out = self._fns[gc](self._px_s, self._in_s, pos_d, starts_d,
+                            rlo_d, rhi_d, inv_d, ints_d, nv_d)
+        return out, table.n_ions
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         out, n = self._dispatch(table)
@@ -201,10 +266,17 @@ class ShardedJaxBackend:
     def score_batches(self, tables) -> list[np.ndarray]:
         """Pipelined like the single-device backend: every batch enqueued
         (async dispatch + sharded device_put) before any result is synced;
-        results fetched concurrently (models/msm_jax.fetch_scored_batches)."""
+        results fetched concurrently (models/msm_jax.fetch_scored_batches).
+        Plans are built up front so the band width (and hence the ONE
+        executable) is fixed before the first dispatch."""
         from ..models.msm_jax import fetch_scored_batches
 
-        return fetch_scored_batches([self._dispatch(t) for t in tables])
+        tables = list(tables)
+        plans = [self._flat_plan(t) for t in tables]
+        for plan in plans:
+            self._gc_width = max(self._gc_width, plan[7])
+        return fetch_scored_batches(
+            [self._dispatch(t, plan) for t, plan in zip(tables, plans)])
 
 
 def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConfig):
