@@ -112,20 +112,39 @@ def _chaos_kernel(img_ref, vmax_ref, out_ref, *, ncols: int, nlevels: int):
     out_ref[:] = lax.fori_loop(0, nlevels, level_body, acc)
 
 
+# Scoped-VMEM budget for one program's block, in CELLS (rows x lanes).  The
+# kernel's live intermediates (labels, open flags, masks, shifted copies)
+# cost ~133 B/cell against the 16 MB scoped limit (measured: a 256x512
+# block = 131072 cells OOMed at 17.46 MB), so cap blocks at ~13 MB.
+_MAX_CELLS = 96 * 1024
+
+
 def _pack_geometry(nrows: int, ncols: int, lane_width: int) -> tuple[int, int, int]:
-    """(R_pad, C_pad, IB): pad cols so IB*C_pad == lane block width."""
+    """(R_pad, C_pad, IB): pad cols so IB*C_pad == lane block width.
+
+    The lane width shrinks when rows are tall so R_pad * lanes stays within
+    the scoped-VMEM budget (_MAX_CELLS); images whose padded column span
+    still exceeds the budget don't fit — callers check ``fits_vmem`` and
+    fall back to the associative-scan path."""
     rp = -(-nrows // 8) * 8
+    budget = max(128, (_MAX_CELLS // rp) // 128 * 128)
+    lane_width = min(lane_width, budget)
     if ncols <= lane_width:
         cp = ncols
-        # smallest power-of-two-ish divisor layout: pad cols up until it
-        # divides the lane width
+        # smallest divisor layout: pad cols up until it divides the lane width
         while lane_width % cp != 0:
             cp += 1
         ib = lane_width // cp
     else:
-        cp = -(-ncols // lane_width) * lane_width
+        cp = -(-ncols // 128) * 128
         ib = 1
     return rp, cp, ib
+
+
+def fits_vmem(nrows: int, ncols: int, lane_width: int = 512) -> bool:
+    """True when one program's block stays inside the scoped-VMEM budget."""
+    rp, cp, ib = _pack_geometry(nrows, ncols, lane_width)
+    return rp * cp * ib <= _MAX_CELLS
 
 
 @functools.partial(jax.jit, static_argnames=("nrows", "ncols", "nlevels", "lane_width", "interpret"))
@@ -145,6 +164,12 @@ def chaos_count_sums(
     """
     n = principal.shape[0]
     rp, cp, ib = _pack_geometry(nrows, ncols, lane_width)
+    if rp * cp * ib > _MAX_CELLS and not interpret:
+        raise ValueError(
+            f"chaos kernel block ({rp}x{cp * ib} cells) exceeds the scoped-"
+            f"VMEM budget ({_MAX_CELLS}); check fits_vmem() and use the "
+            "associative-scan path (measure_of_chaos_batch use_pallas=False)"
+        )
     n_pad = -(-n // ib) * ib
     img = jnp.zeros((n_pad, rp, cp), jnp.float32)
     img = img.at[:n, :nrows, :ncols].set(

@@ -89,7 +89,12 @@ def measure_of_chaos_batch(
     exact, so the dispatch cannot change results.
     """
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        from .chaos_pallas import fits_vmem
+
+        # pallas needs the whole image's connectivity in one VMEM block;
+        # images whose padded (rows x lanes) block exceeds the scoped-VMEM
+        # budget (~96k cells: e.g. 256x385+ or 512x193+) take the scan path
+        use_pallas = jax.default_backend() == "tpu" and fits_vmem(nrows, ncols)
     principal = jnp.maximum(principal, 0.0)
     vmax = principal.max(axis=1)                       # (N,)
     n_notnull = jnp.sum(principal > 0, axis=1)         # (N,)
