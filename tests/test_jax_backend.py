@@ -161,13 +161,14 @@ def test_extraction_flat_bit_identical_to_cube(fixture_ds):
     np.testing.assert_array_equal(flat, cube)
 
 
-def _run(ds, formulas, backend, decoy_n=6, seed=9, batch=64, preprocessing=False):
+def _run(ds, formulas, backend, decoy_n=6, seed=9, batch=64,
+         preprocessing=False, adducts=("+H",)):
     sm_config = SMConfig.from_dict(
         {"backend": backend, "fdr": {"decoy_sample_size": decoy_n, "seed": seed},
          "parallel": {"formula_batch": batch}}
     )
     ds_config = DSConfig.from_dict(
-        {"isotope_generation": {"adducts": ["+H"]},
+        {"isotope_generation": {"adducts": list(adducts)},
          "image_generation": {"ppm": 3.0, "do_preprocessing": preprocessing}}
     )
     return MSMBasicSearch(ds, formulas, ds_config, sm_config).search()
@@ -210,6 +211,29 @@ def test_backend_parity_metrics_and_ranks(fixture_ds, preprocessing):
     np.testing.assert_array_equal(a_np.fdr.to_numpy(), a_jx.fdr.to_numpy())
     np.testing.assert_array_equal(
         a_np.fdr_level.to_numpy(), a_jx.fdr_level.to_numpy())
+
+
+def test_backend_parity_multi_adduct(fixture_ds):
+    """Cross-backend rank parity with the reference's full default target
+    adduct set {+H, +Na, +K} (per-adduct FDR ranking, 3x the windows/ions
+    of the +H-only tests)."""
+    ds, truth = fixture_ds
+    formulas = truth.formulas[:12]
+    adducts = ("+H", "+Na", "+K")
+    b_np = _run(ds, formulas, "numpy_ref", decoy_n=4, seed=7, adducts=adducts)
+    b_jx = _run(ds, formulas, "jax_tpu", decoy_n=4, seed=7, adducts=adducts)
+    a_np, a_jx = b_np.annotations, b_jx.annotations
+    assert set(a_np.adduct) == set(adducts)
+    assert list(zip(a_np.sf, a_np.adduct)) == list(zip(a_jx.sf, a_jx.adduct))
+    np.testing.assert_array_equal(
+        a_np.fdr_level.to_numpy(), a_jx.fdr_level.to_numpy())
+    m_np = b_np.all_metrics.set_index(["sf", "adduct"]).sort_index()
+    m_jx = b_jx.all_metrics.set_index(["sf", "adduct"]).sort_index()
+    assert list(m_np.index) == list(m_jx.index)
+    np.testing.assert_array_equal(
+        m_jx["chaos"].to_numpy(), m_np["chaos"].to_numpy())
+    np.testing.assert_allclose(
+        m_jx["msm"].to_numpy(), m_np["msm"].to_numpy(), atol=1e-6)
 
 
 def test_jax_batch_padding_consistency(fixture_ds):
