@@ -237,13 +237,22 @@ class IsocalcWrapper:
         self._dirty: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         if self.cache_dir is not None:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
-            for path in sorted(self.cache_dir.glob(
-                    f"theor_peaks_{self._param_key()}*.npz")):
+            for path in self._shard_paths():
                 with np.load(path, allow_pickle=False) as z:
-                    for k in z.files:
-                        if k.endswith("/mzs"):
-                            ion = k[: -len("/mzs")]
-                            self._cache[ion] = (z[k], z[ion + "/ints"])
+                    if "ions" in z.files:
+                        # stacked shard: 4 arrays total (2 zip members per
+                        # ion made a 21k-ion warm load take ~30 s)
+                        ions, lens = z["ions"], z["lens"]
+                        mzs, ints = z["mzs"], z["ints"]
+                        for i, ion in enumerate(ions):
+                            ln = int(lens[i])
+                            self._cache[str(ion)] = (
+                                mzs[i, :ln].copy(), ints[i, :ln].copy())
+                    else:  # legacy per-ion-member shard
+                        for k in z.files:
+                            if k.endswith("/mzs"):
+                                ion = k[: -len("/mzs")]
+                                self._cache[ion] = (z[k], z[ion + "/ints"])
 
     def _param_key(self) -> str:
         c = self.cfg
@@ -255,6 +264,24 @@ class IsocalcWrapper:
     def _shard_paths(self) -> list[Path]:
         return sorted(self.cache_dir.glob(f"theor_peaks_{self._param_key()}*.npz"))
 
+    @staticmethod
+    def _stack_entries(entries: dict) -> dict[str, np.ndarray]:
+        """Pack {ion: (mzs, ints)} into 4 stacked arrays (one npz member per
+        ion scales zip overhead with cache size; stacked, a 21k-ion load
+        drops from ~30 s to well under a second)."""
+        ions = list(entries)
+        width = max((entries[i][0].size for i in ions), default=1)
+        n = len(ions)
+        lens = np.zeros(n, dtype=np.int32)
+        mzs = np.zeros((n, width), dtype=np.float64)
+        ints = np.zeros((n, width), dtype=np.float64)
+        for i, ion in enumerate(ions):
+            m, t = entries[ion]
+            lens[i] = m.size
+            mzs[i, : m.size] = m
+            ints[i, : t.size] = t
+        return {"ions": np.array(ions), "lens": lens, "mzs": mzs, "ints": ints}
+
     def save_cache(self) -> None:
         """Persist NEW entries as one incremental shard (atomic rename)."""
         if self.cache_dir is None or not self._dirty:
@@ -262,29 +289,27 @@ class IsocalcWrapper:
         import os
         import uuid
 
-        arrays: dict[str, np.ndarray] = {}
-        for ion, (mzs, ints) in self._dirty.items():
-            arrays[ion + "/mzs"] = mzs
-            arrays[ion + "/ints"] = ints
+        # tmp names use a "tmp_" PREFIX so the constructor's
+        # "theor_peaks_*" glob never sees a half-written file (np.savez
+        # force-appends .npz, so a suffix-based tmp would still match and a
+        # crashed/concurrent save would brick the cache with BadZipFile)
         shard = self.cache_dir / (
             f"theor_peaks_{self._param_key()}_{uuid.uuid4().hex[:8]}.npz")
-        tmp = shard.with_suffix(".tmp.npz")
-        np.savez(tmp, **arrays)
-        tmp.replace(shard)
+        tmp = self.cache_dir / f"tmp_{uuid.uuid4().hex[:8]}.npz"
+        np.savez(tmp, **self._stack_entries(self._dirty))
+        os.replace(tmp, shard)
         self._dirty = {}
         shards = self._shard_paths()
         if len(shards) > self._COMPACT_SHARDS:
-            merged: dict[str, np.ndarray] = {}
-            for ion, (mzs, ints) in self._cache.items():
-                merged[ion + "/mzs"] = mzs
-                merged[ion + "/ints"] = ints
             base = self.cache_dir / f"theor_peaks_{self._param_key()}.npz"
-            tmp = base.with_suffix(".tmp.npz")
-            np.savez(tmp, **merged)
+            tmp = self.cache_dir / f"tmp_{uuid.uuid4().hex[:8]}.npz"
+            np.savez(tmp, **self._stack_entries(self._cache))
+            # replace base BEFORE unlinking shards: a kill in between loses
+            # no entries (shards are only dropped once base holds them all)
+            os.replace(tmp, base)
             for s in shards:
                 if s != base:
                     os.unlink(s)
-            tmp.replace(base)
 
     def _params(self) -> tuple:
         c = self.cfg
