@@ -54,11 +54,17 @@ def _shift(x: jnp.ndarray, d: int, axis: int, reverse: bool, fill) -> jnp.ndarra
     return jnp.where(keep, rolled, fill)
 
 
-def _seg_min_scan(v: jnp.ndarray, o: jnp.ndarray, axis: int, reverse: bool) -> jnp.ndarray:
+def _seg_min_scan(v: jnp.ndarray, o: jnp.ndarray, axis: int, reverse: bool,
+                  span: int | None = None) -> jnp.ndarray:
     """Segmented prefix-min along ``axis`` (Hillis–Steele): o[i]=1 iff the
-    pull window behind i is fully open (masked, no image boundary)."""
+    pull window behind i is fully open (masked, no image boundary).
+
+    ``span`` caps the scan distance: lane blocks pack several images side by
+    side, and a flood can never propagate further than one image's column
+    width (the boundary guard kills longer windows anyway), so scanning to
+    the full block width wastes log2(block/span) doubling steps."""
     d = 1
-    n = v.shape[axis]
+    n = span if span is not None else v.shape[axis]
     while d < n:
         vs = _shift(v, d, axis, reverse, _BIG)
         os_ = _shift(o, d, axis, reverse, np.int32(0))
@@ -89,8 +95,8 @@ def _chaos_kernel(img_ref, vmax_ref, out_ref, *, ncols: int, nlevels: int):
         lab0 = jnp.where(mask, iota, _BIG)
 
         def sweep(lab):
-            lab = _seg_min_scan(lab, o_fwd, 1, False)
-            lab = _seg_min_scan(lab, o_bwd, 1, True)
+            lab = _seg_min_scan(lab, o_fwd, 1, False, span=ncols)
+            lab = _seg_min_scan(lab, o_bwd, 1, True, span=ncols)
             lab = _seg_min_scan(lab, mi, 0, False)
             lab = _seg_min_scan(lab, mi, 0, True)
             return jnp.where(mask, lab, _BIG)
