@@ -201,6 +201,50 @@ def test_daemon_queue_success_failure_poison(fixture_path, tmp_path):
     assert (root / "pending" / "stuck.json").exists()
 
 
+def test_3d_stack_campaign(tmp_path):
+    """BASELINE config #4 analog: a 3-D stack is a campaign of per-slice
+    datasets through ONE queue + ledger (the reference treats a stack as a
+    series of jobs over shared infra).  Each slice gets its own dataset row,
+    FINISHED job, and queryable annotations; the shared isocalc pattern
+    cache is populated by slice 0 and only read by later slices."""
+    slices = []
+    for z in range(3):
+        path, truth = generate_synthetic_dataset(
+            tmp_path / f"slice{z}", nrows=8, ncols=8, formulas=None,
+            present_fraction=0.5, noise_peaks=30, seed=100 + z)
+        slices.append((path, truth))
+    sm = SMConfig.from_dict({
+        "backend": "numpy_ref",
+        "fdr": {"decoy_sample_size": 2, "seed": 5},
+        "storage": {"results_dir": str(tmp_path / "res")},
+        "work_dir": str(tmp_path / "work"),
+    })
+    pub = QueuePublisher(tmp_path / "q")
+    for z, (path, truth) in enumerate(slices):
+        pub.publish({"ds_id": f"stack_z{z}", "input_path": str(path),
+                     "formulas": truth.formulas[:6],
+                     "ds_config": {"isotope_generation": {"adducts": ["+H"]}}})
+    consumer = QueueConsumer(tmp_path / "q", annotate_callback(sm))
+    consumer.run(max_messages=1)           # slice 0 populates the cache
+    cache_shards = sorted((tmp_path / "work" / "isocalc_cache").glob("*.npz"))
+    assert cache_shards, "slice 0 must persist isotope patterns"
+    consumer.run(max_messages=2)           # slices 1-2: cache hits only
+    assert sorted((tmp_path / "work" / "isocalc_cache").glob("*.npz")) == \
+        cache_shards, "later slices must reuse slice 0's pattern cache"
+
+    root = tmp_path / "q" / "sm_annotate"
+    assert len(list(root.glob("done/*.json"))) == 3
+    ledger = JobLedger(tmp_path / "res")
+    index = AnnotationIndex(ledger)
+    for z in range(3):
+        assert (ledger.jobs(f"stack_z{z}").status == "FINISHED").all()
+        rows = index.search(ds_id=f"stack_z{z}")
+        assert len(rows) == 6
+    # slices are independently queryable; a cross-stack query sees all three
+    all_rows = index.search()
+    assert set(all_rows.ds_id) >= {f"stack_z{z}" for z in range(3)}
+
+
 def test_cli_import_run_search(fixture_path, tmp_path, capsys):
     from sm_distributed_tpu.engine.cli import main
 
