@@ -196,6 +196,17 @@ class JaxBackend:
             # flat globally-sorted layout: no padding slots; per-batch bound
             # ranks computed ON HOST against the host copy of the sorted m/z
             # array and shipped as (G,) int32 (see ops/imager_jax.py)
+            # guard: the histogram scratch is (P+1, 2BK+gc) f32 — beyond a
+            # few GB the device OOM is opaque, so fail early with guidance
+            k_est = ds_config.isotope_generation.n_peaks
+            scratch = 4 * (ds.n_pixels + 1) * (2 * self.batch * k_est + 4096)
+            if scratch > (8 << 30):
+                raise ValueError(
+                    f"flat-path histogram scratch would be ~{scratch / 2**30:.0f}"
+                    f" GiB ({ds.n_pixels} pixels x formula_batch={self.batch}"
+                    f" x {k_est} peaks); reduce parallel.formula_batch, shard"
+                    " pixels over a mesh (parallel.pixels_axis), or set"
+                    " parallel.mz_chunk to use the bounded-scratch cube path")
             mz_s, px_s, in_s = prepare_flat_sorted_arrays(ds, self.ppm)
             self._mz_host = mz_s
             self._px_s = jax.device_put(px_s)
