@@ -294,6 +294,12 @@ class MSMBasicSearch:
                         "already scored", done, len(groups))
             else:
                 groups, ckpt, done = [slices], None, 0
+            if len(groups) > 1 and hasattr(backend, "presize"):
+                # per-group score_batches calls would otherwise pre-size
+                # static shapes per GROUP and recompile when a later group
+                # needs a wider band (models/msm_jax.py::presize)
+                backend.presize(
+                    _slice_table(table, s, e) for s, e in slices)
             for gi, group in enumerate(groups):
                 if gi < done:
                     continue

@@ -320,6 +320,19 @@ class JaxBackend:
         imgs[~valid] = 0.0
         return imgs
 
+    def presize(self, tables) -> None:
+        """Grow the sticky static shapes to cover ``tables`` WITHOUT scoring.
+
+        score_batches pre-sizes its own stream, but a checkpointed search
+        calls score_batches once per batch GROUP — a later group with a
+        wider window-chunk span would otherwise grow gc_width mid-search
+        and recompile (~15 s on a tunneled TPU).  The orchestrator calls
+        this once with every slice before the group loop."""
+        if self.mz_chunk:
+            return
+        for t in tables:
+            self._gc_width = max(self._gc_width, self._flat_plan(t)[5][4])
+
     def score_batches(self, tables) -> list[np.ndarray]:
         """Pipelined scoring: enqueue every batch before syncing any result
         (JAX dispatch is async, so device compute of all batches overlaps the

@@ -238,21 +238,27 @@ class IsocalcWrapper:
         if self.cache_dir is not None:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
             for path in self._shard_paths():
-                with np.load(path, allow_pickle=False) as z:
-                    if "ions" in z.files:
-                        # stacked shard: 4 arrays total (2 zip members per
-                        # ion made a 21k-ion warm load take ~30 s)
-                        ions, lens = z["ions"], z["lens"]
-                        mzs, ints = z["mzs"], z["ints"]
-                        for i, ion in enumerate(ions):
-                            ln = int(lens[i])
-                            self._cache[str(ion)] = (
-                                mzs[i, :ln].copy(), ints[i, :ln].copy())
-                    else:  # legacy per-ion-member shard
-                        for k in z.files:
-                            if k.endswith("/mzs"):
-                                ion = k[: -len("/mzs")]
-                                self._cache[ion] = (z[k], z[ion + "/ints"])
+                self._cache.update(self._load_shard(path))
+
+    @staticmethod
+    def _load_shard(path) -> dict:
+        """{ion: (mzs, ints)} from one cache shard.  Stacked format: 4
+        arrays total (2 zip members per ion made a 21k-ion warm load take
+        ~30 s); legacy per-ion-member shards still read."""
+        out: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+        with np.load(path, allow_pickle=False) as z:
+            if "ions" in z.files:
+                ions, lens = z["ions"], z["lens"]
+                mzs, ints = z["mzs"], z["ints"]
+                for i, ion in enumerate(ions):
+                    ln = int(lens[i])
+                    out[str(ion)] = (mzs[i, :ln].copy(), ints[i, :ln].copy())
+            else:  # legacy per-ion-member shard
+                for k in z.files:
+                    if k.endswith("/mzs"):
+                        ion = k[: -len("/mzs")]
+                        out[ion] = (z[k], z[ion + "/ints"])
+        return out
 
     def _param_key(self) -> str:
         c = self.cfg
@@ -301,15 +307,25 @@ class IsocalcWrapper:
         self._dirty = {}
         shards = self._shard_paths()
         if len(shards) > self._COMPACT_SHARDS:
+            # merge from the shard FILES, not this process's in-memory view:
+            # a concurrent process may have written shards since our init,
+            # and compacting from _cache alone would silently drop them
+            merged: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+            for path in shards:
+                try:
+                    merged.update(self._load_shard(path))
+                except Exception:
+                    continue  # shard a concurrent compactor already removed
+            merged.update(self._cache)
             base = self.cache_dir / f"theor_peaks_{self._param_key()}.npz"
             tmp = self.cache_dir / f"tmp_{uuid.uuid4().hex[:8]}.npz"
-            np.savez(tmp, **self._stack_entries(self._cache))
+            np.savez(tmp, **self._stack_entries(merged))
             # replace base BEFORE unlinking shards: a kill in between loses
             # no entries (shards are only dropped once base holds them all)
             os.replace(tmp, base)
             for s in shards:
                 if s != base:
-                    os.unlink(s)
+                    s.unlink(missing_ok=True)  # concurrent compactor race
 
     def _params(self) -> tuple:
         c = self.cfg

@@ -278,6 +278,13 @@ class ShardedJaxBackend:
         return fetch_scored_batches(
             [self._dispatch(t, plan) for t, plan in zip(tables, plans)])
 
+    def presize(self, tables) -> None:
+        """Grow the sticky band width to cover ``tables`` without scoring
+        (see JaxBackend.presize — avoids mid-search recompiles when the
+        orchestrator scores in checkpoint groups)."""
+        for t in tables:
+            self._gc_width = max(self._gc_width, self._flat_plan(t)[7])
+
 
 def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConfig):
     """Pick single-device fused graph or the mesh-sharded variant based on the

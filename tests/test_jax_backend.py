@@ -236,6 +236,33 @@ def test_backend_parity_multi_adduct(fixture_ds):
         m_jx["msm"].to_numpy(), m_np["msm"].to_numpy(), atol=1e-6)
 
 
+def test_jax_checkpointed_search_matches_plain(fixture_ds, tmp_path):
+    """Checkpoint-grouped scoring (backend.presize + per-group
+    score_batches) must produce the same annotations as one ungrouped
+    stream on the jax backend."""
+    import pandas.testing as pdt
+
+    ds, truth = fixture_ds
+    formulas = truth.formulas[:10]
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+
+    def run(extra):
+        sm_config = SMConfig.from_dict(
+            {"backend": "jax_tpu",
+             "fdr": {"decoy_sample_size": 4, "seed": 3},
+             "parallel": {"formula_batch": 16, **extra}})
+        return MSMBasicSearch(
+            ds, formulas, ds_config, sm_config,
+            checkpoint_dir=str(tmp_path) if extra else None,
+        ).search().annotations
+
+    plain = run({})
+    grouped = run({"checkpoint_every": 1})
+    pdt.assert_frame_equal(grouped, plain)
+
+
 def test_jax_batch_padding_consistency(fixture_ds):
     # results must not depend on formula_batch (padding correctness)
     ds, truth = fixture_ds
