@@ -113,7 +113,10 @@ class ParallelConfig:
     of master/executor-memory. axis sizes of -1 mean 'use all devices'."""
     pixels_axis: int = -1                # mesh axis sharding the pixel dimension
     formulas_axis: int = 1               # mesh axis sharding the formula dimension
-    formula_batch: int = 512             # ions scored per fused-graph invocation
+    # ions scored per fused-graph invocation: 2048 balances histogram-
+    # scatter amortization against padding waste (measured sweep on v5e,
+    # docs/PERF.md); batches pad to this so small jobs may prefer less
+    formula_batch: int = 2048
     mz_chunk: int = 0                    # 0 = no m/z chunking inside the kernel
     # multi-host (DCN) runtime — jax.distributed.initialize; the analog of
     # the reference's spark.master cluster address (SURVEY.md §5.8).  Env
