@@ -84,7 +84,17 @@ def _chaos_kernel(img_ref, vmax_ref, out_ref, *, ncols: int, nlevels: int):
     iota = row * ncols + incol                         # per-image pixel id
     vmax = vmax_ref[:]                                 # (1, IBC) f32, per-lane
 
-    def level_body(li, acc):
+    def level_body(li_rev, carry):
+        # Levels run DESCENDING (highest threshold first): masks only GROW
+        # going down, so components only MERGE and the previous level's
+        # final labels are exact warm-start labels — each old component's
+        # label is the iota of one of its pixels, so the flood min over a
+        # merged component is still its true min-iota, and that root pixel
+        # stays in the mask (root counting stays valid).  Newly exposed
+        # pixels start at their own iota.  Warm starts pre-merge most of
+        # the structure, cutting sweeps-to-fixpoint on the dense low levels.
+        acc, prev_lab = carry
+        li = nlevels - 1 - li_rev
         # threshold grid identical to the oracle: vmax * li/nlevels,
         # f32 arithmetic (li/nlevels rounds exactly as arange/nlevels)
         thr = vmax * (li.astype(jnp.float32) / np.float32(nlevels))
@@ -92,7 +102,7 @@ def _chaos_kernel(img_ref, vmax_ref, out_ref, *, ncols: int, nlevels: int):
         mi = mask.astype(jnp.int32)
         o_fwd = mi * (incol != 0)
         o_bwd = mi * (incol != ncols - 1)
-        lab0 = jnp.where(mask, iota, _BIG)
+        lab0 = jnp.where(mask, jnp.minimum(prev_lab, iota), _BIG)
 
         def sweep(lab):
             lab = _seg_min_scan(lab, o_fwd, 1, False, span=ncols)
@@ -112,10 +122,11 @@ def _chaos_kernel(img_ref, vmax_ref, out_ref, *, ncols: int, nlevels: int):
         lab, _ = lax.while_loop(cond, body, (sweep(lab0), lab0))
         cnt = jnp.sum(((lab == iota) & mask).astype(jnp.int32), axis=0,
                       keepdims=True)                   # (1, IBC) per-lane
-        return acc + cnt
+        return acc + cnt, lab
 
     acc = jnp.zeros((1, shape[1]), jnp.int32)
-    out_ref[:] = lax.fori_loop(0, nlevels, level_body, acc)
+    big = jnp.full(shape, _BIG, jnp.int32)
+    out_ref[:] = lax.fori_loop(0, nlevels, level_body, (acc, big))[0]
 
 
 # Scoped-VMEM budget for one program's block, in CELLS (rows x lanes).  The
