@@ -182,7 +182,11 @@ class AnnotationIndex:
         adduct: str | None = None,
         max_fdr_level: float | None = None,
         min_msm: float | None = None,
+        mz_min: float | None = None,
+        mz_max: float | None = None,
     ) -> pd.DataFrame:
+        """Query annotations; mz_min/mz_max cover the reference webapp's
+        search-by-mass use of the ES index (principal-peak ion m/z)."""
         clauses, args = [], []
         for col, val in (("ds_id", ds_id), ("sf", sf), ("adduct", adduct)):
             if val is not None:
@@ -194,6 +198,12 @@ class AnnotationIndex:
         if min_msm is not None:
             clauses.append("msm>=?")
             args.append(min_msm)
+        if mz_min is not None:
+            clauses.append("mz>=?")
+            args.append(mz_min)
+        if mz_max is not None:
+            clauses.append("mz<=?")
+            args.append(mz_max)
         q = "SELECT * FROM annotation"
         if clauses:
             q += " WHERE " + " AND ".join(clauses)

@@ -72,6 +72,9 @@ def test_annotation_index_roundtrip_and_job_scoped_delete(tmp_path):
     hits = index.search(ds_id="ds1", max_fdr_level=0.1)
     assert list(hits.sf) == ["C6H12O6"]
     assert hits.mz.iloc[0] == pytest.approx(181.07)
+    # m/z-range query (the reference webapp's search-by-mass on the ES index)
+    assert list(index.search(mz_min=181.0, mz_max=181.1).sf) == ["C6H12O6"]
+    assert index.search(mz_min=200.0).empty
     # job-scoped delete must not erase other jobs' rows
     index._conn.execute(
         "INSERT INTO annotation VALUES('ds1',2,'X','+H',1,0.5,0.1,0.2,0.5,0.5,0.5)"
