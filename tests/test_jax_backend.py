@@ -263,6 +263,40 @@ def test_jax_checkpointed_search_matches_plain(fixture_ds, tmp_path):
     pdt.assert_frame_equal(grouped, plain)
 
 
+def test_negative_mode_end_to_end_parity(tmp_path_factory):
+    """Negative ion mode (charge=-1, -H target adduct — the reference's
+    polarity '-' datasets): signal present at [M-H]- m/z must be found, and
+    backend ranks must stay identical."""
+    from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    out = tmp_path_factory.mktemp("dsneg")
+    iso = IsotopeGenerationConfig(adducts=("-H",), charge=-1)
+    path, truth = generate_synthetic_dataset(
+        out, nrows=10, ncols=10, formulas=None, present_fraction=0.5,
+        noise_peaks=40, seed=31, adduct="-H", iso_cfg=iso,
+    )
+    ds = SpectralDataset.from_imzml(path)
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["-H"], "charge": -1},
+         "image_generation": {"ppm": 3.0}})
+    res = {}
+    for backend in ("numpy_ref", "jax_tpu"):
+        sm_config = SMConfig.from_dict(
+            {"backend": backend, "fdr": {"decoy_sample_size": 4, "seed": 2},
+             "parallel": {"formula_batch": 64}})
+        res[backend] = MSMBasicSearch(
+            ds, list(truth.formulas), ds_config, sm_config).search().annotations
+    a_np, a_jx = res["numpy_ref"], res["jax_tpu"]
+    assert set(a_np.adduct) == {"-H"}
+    # present formulas score strongly in negative mode
+    present = a_np[a_np.sf.isin(truth.present)]
+    assert (present.msm > 0.2).all()
+    assert list(zip(a_np.sf, a_np.adduct)) == list(zip(a_jx.sf, a_jx.adduct))
+    np.testing.assert_array_equal(
+        a_np.fdr_level.to_numpy(), a_jx.fdr_level.to_numpy())
+
+
 def test_jax_batch_padding_consistency(fixture_ds):
     # results must not depend on formula_batch (padding correctness)
     ds, truth = fixture_ds
