@@ -122,11 +122,17 @@ def main() -> None:
         n_valid=table.n_valid[sel], targets=table.targets[sel],
     )
     np_backend.score_batch(_slice_table(table, 0, 2))  # warm caches
-    t0 = time.perf_counter()
-    np_backend.score_batch(sub)
-    np_dt = time.perf_counter() - t0
+    # median of 3: the shared-host floor varies ~±20% run to run, and
+    # vs_baseline should not ride that noise
+    np_dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np_backend.score_batch(sub)
+        np_dts.append(time.perf_counter() - t0)
+    np_dt = sorted(np_dts)[1]
     np_rate = sub.n_ions / np_dt
-    logger.info("numpy_ref: %d ions in %.2fs -> %.1f ions/s", sub.n_ions, np_dt, np_rate)
+    logger.info("numpy_ref: %d ions in %.2fs (median of 3) -> %.1f ions/s",
+                sub.n_ions, np_dt, np_rate)
 
     print(json.dumps({
         "metric": "ions_scored_per_sec_per_chip",

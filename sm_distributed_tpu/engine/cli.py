@@ -80,7 +80,8 @@ def cmd_search(args) -> int:
     from .storage import AnnotationIndex, JobLedger
 
     index = AnnotationIndex(JobLedger(sm_config.storage.results_dir))
-    df = index.search(ds_id=args.ds_id, sf=args.sf, max_fdr_level=args.max_fdr)
+    df = index.search(ds_id=args.ds_id, sf=args.sf, max_fdr_level=args.max_fdr,
+                      mz_min=args.mz_min, mz_max=args.mz_max)
     print(df.to_string(index=False) if not df.empty else "(no annotations)")
     return 0
 
@@ -114,6 +115,8 @@ def build_parser() -> argparse.ArgumentParser:
     srch.add_argument("--ds-id", default=None)
     srch.add_argument("--sf", default=None)
     srch.add_argument("--max-fdr", type=float, default=None)
+    srch.add_argument("--mz-min", type=float, default=None)
+    srch.add_argument("--mz-max", type=float, default=None)
     srch.add_argument("--sm-config", default=None)
     srch.set_defaults(fn=cmd_search)
     return ap

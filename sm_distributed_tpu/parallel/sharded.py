@@ -169,6 +169,11 @@ class ShardedJaxBackend:
             n_form_shards * n_pix_shards)
         img_cfg = ds_config.image_generation
         self.ppm = img_cfg.ppm
+        if sm_config.parallel.mz_chunk:
+            logger.warning(
+                "parallel.mz_chunk is ignored on a multi-device mesh: the "
+                "sharded backend's per-shard flat layout already bounds "
+                "per-device memory (pixels/%d)", n_pix_shards)
 
         mz_s, px_s, in_s, self._p_loc = prepare_flat_sharded_arrays(
             ds, self.ppm, n_pix_shards)
