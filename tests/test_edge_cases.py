@@ -1,0 +1,77 @@
+"""Edge-of-domain regression tests: degenerate datasets, extreme values,
+and malformed formula lists must neither crash nor break backend parity."""
+
+import numpy as np
+import pandas.testing as pdt
+import pytest
+
+from sm_distributed_tpu.io.dataset import SpectralDataset
+from sm_distributed_tpu.models.msm_basic import MSMBasicSearch
+from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+DS_CONFIG = DSConfig.from_dict(
+    {"isotope_generation": {"adducts": ["+H"]},
+     "image_generation": {"ppm": 3.0}})
+
+
+def _run(ds, formulas, backend, batch=8):
+    sm = SMConfig.from_dict(
+        {"backend": backend, "fdr": {"decoy_sample_size": 2, "seed": 1},
+         "parallel": {"formula_batch": batch}})
+    return MSMBasicSearch(ds, formulas, DS_CONFIG, sm).search().annotations
+
+
+_COORDS = np.array([[1, 1], [2, 1], [1, 2], [2, 2]])
+
+
+def test_fully_empty_dataset():
+    empty = [(np.array([], dtype=float), np.array([], dtype=float))] * 4
+    ds = SpectralDataset.from_arrays(_COORDS, empty)
+    for backend in ("numpy_ref", "jax_tpu"):
+        ann = _run(ds, ["C6H12O6", "H2O"], backend)
+        assert (ann.msm == 0).all()
+
+
+def test_single_pixel_dataset_parity():
+    ds = SpectralDataset.from_arrays(
+        np.array([[1, 1]]), [(np.array([181.070665]), np.array([5.0]))])
+    a = _run(ds, ["C6H12O6"], "numpy_ref")
+    b = _run(ds, ["C6H12O6"], "jax_tpu")
+    pdt.assert_frame_equal(a, b)
+
+
+def test_huge_intensities_exact_parity():
+    """1e10-1e12 intensities: the shared integer grid must rescale so sums
+    stay exact in f32, keeping cross-backend bits identical."""
+    rng = np.random.default_rng(0)
+    spectra = [(np.sort(rng.uniform(100, 500, 50)),
+                rng.uniform(1e10, 1e12, 50)) for _ in range(4)]
+    ds = SpectralDataset.from_arrays(_COORDS, spectra)
+    a = _run(ds, ["C6H12O6", "C5H5N5"], "numpy_ref")
+    b = _run(ds, ["C6H12O6", "C5H5N5"], "jax_tpu")
+    np.testing.assert_array_equal(a.msm.to_numpy(), b.msm.to_numpy())
+
+
+def test_unknown_element_formula_dropped():
+    """A formula with an element outside the isotope table is dropped by
+    pattern generation; the rest of the search proceeds."""
+    ds = SpectralDataset.from_arrays(
+        np.array([[1, 1]]), [(np.array([181.070665]), np.array([5.0]))])
+    ann = _run(ds, ["C6H12O6", "C2U3Xx9"], "numpy_ref")
+    assert sorted(set(ann.sf)) == ["C6H12O6"]
+
+
+def test_mz_near_quantization_ceiling():
+    """Peaks near the int32 m/z ceiling (21 kDa) must not overflow."""
+    sp = [(np.array([21000.0]), np.array([3.0]))] * 4
+    ds = SpectralDataset.from_arrays(_COORDS, sp)
+    ann = _run(ds, ["C6H12O6"], "jax_tpu")
+    assert np.isfinite(ann.msm).all()
+
+
+def test_one_ion_batches_match_large_batches():
+    ds = SpectralDataset.from_arrays(
+        np.array([[1, 1]]), [(np.array([181.070665]), np.array([5.0]))])
+    a1 = _run(ds, ["C6H12O6", "H2O"], "jax_tpu", batch=1)
+    a8 = _run(ds, ["C6H12O6", "H2O"], "jax_tpu", batch=8)
+    pdt.assert_frame_equal(a1, a8)
