@@ -91,7 +91,7 @@ def main() -> None:
                for s in range(0, table.n_ions, b)]
 
     # --- jax_tpu timing (compile excluded via warmup) -------------------
-    backend = make_backend("jax_tpu", ds, ds_config, sm_config)
+    backend = make_backend("jax_tpu", ds, ds_config, sm_config, table=table)
     t0 = time.perf_counter()
     backend.score_batch(batches[0])
     compile_dt = time.perf_counter() - t0

@@ -75,13 +75,16 @@ class NumpyBackend:
 
 
 def make_backend(name: str, ds: SpectralDataset, ds_config: DSConfig,
-                 sm_config: SMConfig):
+                 sm_config: SMConfig, table: IsotopePatternTable | None = None):
+    """``table``: the search's full ion table, when known up front — the jax
+    backends drop dataset peaks outside the union of its windows (exact;
+    the reference's "only hits shuffle" property)."""
     if name == "numpy_ref":
         return NumpyBackend(ds, ds_config)
     if name == "jax_tpu":
         from ..parallel.sharded import make_jax_backend  # deferred: jax import is heavy
 
-        return make_jax_backend(ds, ds_config, sm_config)
+        return make_jax_backend(ds, ds_config, sm_config, restrict_table=table)
     raise ValueError(f"unknown backend {name!r}")
 
 
@@ -263,7 +266,8 @@ class MSMBasicSearch:
             int((~table.targets).sum()), self.sm_config.backend,
         )
         backend = make_backend(
-            self.sm_config.backend, self.ds, self.ds_config, self.sm_config
+            self.sm_config.backend, self.ds, self.ds_config, self.sm_config,
+            table=table,
         )
         self.last_backend = backend
         batch = max(1, self.sm_config.parallel.formula_batch)

@@ -281,6 +281,70 @@ def prepare_flat_sharded_arrays(
     return mz_s, px_s, in_s, p_loc
 
 
+def merged_window_bounds(lo_q: np.ndarray, hi_q: np.ndarray) -> np.ndarray:
+    """Host-side: the union of half-open quantized windows [lo, hi) as a
+    flat sorted boundary array [lo1, hi1, lo2, hi2, ...] of DISJOINT
+    intervals.  Membership test: searchsorted(flat, mz, 'right') is odd."""
+    lo = np.asarray(lo_q, dtype=np.int64).ravel()
+    hi = np.asarray(hi_q, dtype=np.int64).ravel()
+    real = lo < hi                       # drop empty windows (batch padding)
+    lo, hi = lo[real], hi[real]
+    if lo.size == 0:
+        return np.zeros(0, dtype=np.int32)
+    order = np.argsort(lo, kind="stable")
+    lo, hi = lo[order], hi[order]
+    run_hi = np.maximum.accumulate(hi)
+    # a new disjoint interval starts where lo exceeds every prior hi
+    # (touching intervals merge too, keeping the parity test valid)
+    new = np.concatenate([[True], lo[1:] > run_hi[:-1]])
+    starts = lo[new]
+    ends = run_hi[np.concatenate([new[1:], [True]])]
+    return np.stack([starts, ends], axis=1).ravel().astype(np.int32)
+
+
+def window_union_member(mz_q: np.ndarray, flat_bounds: np.ndarray) -> np.ndarray:
+    """Boolean mask: which quantized m/z values fall inside ANY window of
+    the union (the reference's searchsorted hot loop only emits hits
+    [U, formula_imager_segm]; this is the dataset-side equivalent —
+    peaks outside every window of a SEARCH can never contribute and are
+    dropped from the device arrays up front)."""
+    if flat_bounds.size == 0:
+        return np.zeros(mz_q.shape, dtype=bool)
+    return (np.searchsorted(flat_bounds, mz_q, side="right") % 2) == 1
+
+
+def restrict_flat_to_windows(
+    mz_s: np.ndarray,    # (S, N) int32 per-shard sorted, MZ_PAD_Q padding
+    px_s: np.ndarray,    # (S, N) int32
+    in_s: np.ndarray,    # (S, N) f32
+    lo_q: np.ndarray,    # window lo bounds (any shape; empty lo==hi dropped)
+    hi_q: np.ndarray,
+    overflow_row: int,
+    pad_to_multiple: int = 1024,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
+    """Keep only peaks inside the union of the windows; re-pad each shard
+    row to the new common length.  Returns (mz, px, ints, max_kept).
+
+    Exact: dropped peaks match no window, so every image bit is unchanged;
+    padding rows (MZ_PAD_Q sentinel) sit outside every real window and drop
+    with the rest.  Table padding rows quantize to the empty window (0, 0),
+    which merged_window_bounds already drops — callers pass raw bounds."""
+    flat = merged_window_bounds(lo_q, hi_q)
+    keeps = [window_union_member(mz_s[s], flat) for s in range(mz_s.shape[0])]
+    n_eff = max((int(k.sum()) for k in keeps), default=1)
+    n_pad = -(-max(n_eff, 1) // pad_to_multiple) * pad_to_multiple
+    s_count = mz_s.shape[0]
+    mz_k = np.full((s_count, n_pad), MZ_PAD_Q, dtype=np.int32)
+    px_k = np.full((s_count, n_pad), overflow_row, dtype=np.int32)
+    in_k = np.zeros((s_count, n_pad), dtype=np.float32)
+    for s, k in enumerate(keeps):
+        c = int(k.sum())
+        mz_k[s, :c] = mz_s[s][k]
+        px_k[s, :c] = px_s[s][k]
+        in_k[s, :c] = in_s[s][k]
+    return mz_k, px_k, in_k, n_eff
+
+
 # -- m/z-chunked extraction ---------------------------------------------------
 #
 # The reference segments the m/z range so each task's working set stays

@@ -153,6 +153,7 @@ class ShardedJaxBackend:
         ds_config: DSConfig,
         sm_config: SMConfig,
         mesh: Mesh | None = None,
+        restrict_table: IsotopePatternTable | None = None,
     ):
         from .distributed import enable_compile_cache
 
@@ -177,6 +178,9 @@ class ShardedJaxBackend:
 
         mz_s, px_s, in_s, self._p_loc = prepare_flat_sharded_arrays(
             ds, self.ppm, n_pix_shards)
+        if restrict_table is not None:
+            mz_s, px_s, in_s = self._restrict_shards(
+                mz_s, px_s, in_s, restrict_table)
         self.int_scale = ds.intensity_quantization(self.ppm)[1]
         flat_sharding = NamedSharding(self.mesh, P(PIXELS_AXIS, None))
         self._mz_shards = mz_s                 # host-side, for bound ranks
@@ -204,6 +208,20 @@ class ShardedJaxBackend:
         )
         self._fns: dict[int, object] = {}      # gc_width -> jitted step
         self._gc_width = 0                     # sticky (see JaxBackend)
+
+    def _restrict_shards(self, mz_s, px_s, in_s, table):
+        """Drop peaks outside the union of ``table``'s windows from every
+        pixel shard's row and re-pad rows to the new common length (exact —
+        ops/imager_jax.restrict_flat_to_windows)."""
+        from ..ops.imager_jax import restrict_flat_to_windows
+
+        lo_q, hi_q = quantize_window(table.mzs, self.ppm)
+        mz_k, px_k, in_k, n_eff = restrict_flat_to_windows(
+            mz_s, px_s, in_s, lo_q, hi_q, overflow_row=self._p_loc)
+        logger.info(
+            "window-union restriction: %d -> %d peaks/shard max",
+            mz_s.shape[1], n_eff)
+        return mz_k, px_k, in_k
 
     def _flat_plan(self, table: IsotopePatternTable):
         """Host prep: per-formula-shard bound grids + chunk plans + the
@@ -291,9 +309,12 @@ class ShardedJaxBackend:
             self._gc_width = max(self._gc_width, self._flat_plan(t)[7])
 
 
-def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConfig):
+def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig,
+                     sm_config: SMConfig, restrict_table=None):
     """Pick single-device fused graph or the mesh-sharded variant based on the
-    resolved mesh size (1x1 mesh -> single device, no collectives)."""
+    resolved mesh size (1x1 mesh -> single device, no collectives).
+    ``restrict_table``: the search's full ion table — peaks outside the
+    union of its windows are dropped from the device arrays (exact)."""
     from .distributed import maybe_initialize_distributed
 
     maybe_initialize_distributed(sm_config.parallel)  # no-op single-process
@@ -301,5 +322,7 @@ def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig, sm_config: SMConf
     if mesh.size == 1:
         from ..models.msm_jax import JaxBackend
 
-        return JaxBackend(ds, ds_config, sm_config)
-    return ShardedJaxBackend(ds, ds_config, sm_config, mesh=mesh)
+        return JaxBackend(ds, ds_config, sm_config,
+                          restrict_table=restrict_table)
+    return ShardedJaxBackend(ds, ds_config, sm_config, mesh=mesh,
+                             restrict_table=restrict_table)

@@ -263,6 +263,32 @@ def test_jax_checkpointed_search_matches_plain(fixture_ds, tmp_path):
     pdt.assert_frame_equal(grouped, plain)
 
 
+def test_window_union_restriction_bit_exact(fixture_ds):
+    """Dropping peaks outside the union of the search's windows must leave
+    every scored bit unchanged (dropped peaks match no window)."""
+    from sm_distributed_tpu.models.msm_jax import JaxBackend
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    ds, truth = fixture_ds
+    calc = IsocalcWrapper(IsotopeGenerationConfig(adducts=("+H",)))
+    table = calc.pattern_table([(sf, "+H") for sf in truth.formulas[:15]])
+    sm_config = SMConfig.from_dict(
+        {"backend": "jax_tpu", "parallel": {"formula_batch": 32}})
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+    full = JaxBackend(ds, ds_config, sm_config)
+    restricted = JaxBackend(ds, ds_config, sm_config, restrict_table=table)
+    assert restricted._mz_host.size < full._mz_host.size  # actually dropped
+    a = full.score_batch(table)
+    b = restricted.score_batch(table)
+    np.testing.assert_array_equal(a, b)
+    # device ion-image export equally exact
+    np.testing.assert_array_equal(
+        full.extract_ion_images(table), restricted.extract_ion_images(table))
+
+
 def test_negative_mode_end_to_end_parity(tmp_path_factory):
     """Negative ion mode (charge=-1, -H target adduct — the reference's
     polarity '-' datasets): signal present at [M-H]- m/z must be found, and

@@ -77,6 +77,25 @@ def test_sharded_matches_single_device(fixture_ds, pix, form):
     np.testing.assert_array_equal(got, want)
 
 
+def test_sharded_window_restriction_bit_exact(fixture_ds):
+    """Per-shard window-union restriction must leave sharded scores
+    bit-identical (dropped peaks match no window of the search)."""
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
+
+    ds, truth = fixture_ds
+    table = _table(truth)
+    dc = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    sm = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "parallel": {"formula_batch": 32, "pixels_axis": 4,
+                      "formulas_axis": 2}})
+    full = ShardedJaxBackend(ds, dc, sm)
+    restricted = ShardedJaxBackend(ds, dc, sm, restrict_table=table)
+    assert restricted._mz_shards.shape[1] < full._mz_shards.shape[1]
+    np.testing.assert_array_equal(
+        restricted.score_batch(table), full.score_batch(table))
+
+
 def test_sharded_with_preprocessing(fixture_ds):
     from sm_distributed_tpu.models.msm_jax import JaxBackend
     from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
