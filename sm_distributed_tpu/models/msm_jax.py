@@ -40,31 +40,6 @@ from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
 
 
-def fused_score_fn(
-    mz_q_cube: jnp.ndarray,    # (P_pad, L) int32
-    int_cube: jnp.ndarray,     # (P_pad, L) f32
-    grid: jnp.ndarray,         # (2*B*K,) int32 sorted window bounds
-    r_lo: jnp.ndarray,         # (B, K) int32 grid ranks
-    r_hi: jnp.ndarray,         # (B, K) int32 grid ranks
-    theor_ints: jnp.ndarray,   # (B, K) f32
-    n_valid: jnp.ndarray,      # (B,) i32
-    *,
-    nrows: int,
-    ncols: int,
-    nlevels: int,
-    do_preprocessing: bool,
-    q: float,
-) -> jnp.ndarray:
-    """images -> metrics for one formula batch: (B, 4). One XLA graph."""
-    b, k = r_lo.shape
-    imgs = extract_images(mz_q_cube, int_cube, grid, r_lo.ravel(), r_hi.ravel())
-    imgs = imgs.reshape(b, k, -1)[:, :, : nrows * ncols]   # drop padded pixels
-    return batch_metrics(
-        imgs, theor_ints, n_valid, nrows, ncols, nlevels,
-        do_preprocessing=do_preprocessing, q=q,
-    )
-
-
 def fused_score_fn_flat_banded(
     pixel_sorted: jnp.ndarray,  # (N,) int32
     int_sorted: jnp.ndarray,   # (N,) f32
@@ -118,7 +93,7 @@ def fused_score_fn_chunked(
     do_preprocessing: bool,
     q: float,
 ) -> jnp.ndarray:
-    """As fused_score_fn, but extraction loops over m/z chunks so the
+    """Fused cube-path scoring: extraction loops over m/z chunks so the
     histogram scratch is bounded at (P, gc_width+2) — SURVEY §5.7 m/z-segment
     axis.  Ion images (and hence chaos, which is integer-count based) are
     bit-identical to the unchunked path; spatial/spectral can differ by ulps
