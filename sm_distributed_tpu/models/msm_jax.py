@@ -60,9 +60,9 @@ def fused_score_fn_flat_banded(
     do_preprocessing: bool,
     q: float,
 ) -> jnp.ndarray:
-    """fused_score_fn_flat with the banded membership matmul — flops linear
-    in the batch, so large batches amortize the histogram scatter (see
-    ops/imager_jax.py::extract_images_flat_banded)."""
+    """Fused flat-path scoring: banded-matmul extraction (flops linear in
+    the batch, so large batches amortize the histogram scatter — see
+    ops/imager_jax.py::extract_images_flat_banded) + MSM metrics."""
     imgs = extract_images_flat_banded(
         pixel_sorted, int_sorted, pos, starts, r_lo_loc, r_hi_loc, inv,
         gc_width=gc_width, n_pixels=nrows * ncols)
