@@ -69,6 +69,32 @@ def test_mz_near_quantization_ceiling():
     assert np.isfinite(ann.msm).all()
 
 
+@pytest.mark.parametrize("seed", [3, 17])
+def test_randomized_dataset_backend_parity(seed):
+    """Property-style check: on randomly generated ragged datasets (uniform
+    noise, no planted signal), annotation order and FDR levels must be
+    identical across backends — exactness cannot depend on the data having
+    the fixtures' structure."""
+    rng = np.random.default_rng(seed)
+    n_side = 6
+    coords = np.array([[x, y] for y in range(1, n_side + 1)
+                       for x in range(1, n_side + 1)])
+    spectra = []
+    for _ in range(coords.shape[0]):
+        n = int(rng.integers(0, 120))        # ragged, some pixels empty
+        mzs = np.sort(rng.uniform(80, 600, n))
+        ints = rng.lognormal(3, 2, n)
+        spectra.append((mzs, ints))
+    ds = SpectralDataset.from_arrays(coords, spectra)
+    formulas = ["C6H12O6", "C5H5N5", "C16H32O2", "C9H11NO2", "C3H7NO3"]
+    a = _run(ds, formulas, "numpy_ref", batch=4)
+    b = _run(ds, formulas, "jax_tpu", batch=4)
+    assert list(zip(a.sf, a.adduct)) == list(zip(b.sf, b.adduct))
+    np.testing.assert_array_equal(a.fdr.to_numpy(), b.fdr.to_numpy())
+    np.testing.assert_array_equal(
+        a.fdr_level.to_numpy(), b.fdr_level.to_numpy())
+
+
 def test_one_ion_batches_match_large_batches():
     ds = SpectralDataset.from_arrays(
         np.array([[1, 1]]), [(np.array([181.070665]), np.array([5.0]))])
