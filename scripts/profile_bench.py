@@ -4,6 +4,11 @@ Times each stage (extraction, chaos, correlation, pattern match) as its own
 jitted function with block_until_ready, on the same synthetic dataset and
 batch shapes bench.py uses.  Run on the real chip to attribute cost before
 optimizing (VERDICT round-1 item 2).
+
+Uses the production flat-banded path via the backend's own batch plan
+(``JaxBackend._flat_plan``), so the profiled signature can never drift from
+what ``score_batch`` actually runs (ADVICE r2: the previous version kept a
+private copy of the removed cube signature and crashed).
 """
 
 from __future__ import annotations
@@ -13,44 +18,45 @@ from functools import partial
 from pathlib import Path
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from sm_distributed_tpu.io.dataset import SpectralDataset
 from sm_distributed_tpu.io.fixtures import FIXTURE_FORMULAS, generate_synthetic_dataset
-from sm_distributed_tpu.models.msm_jax import JaxBackend
 from sm_distributed_tpu.models.msm_basic import _slice_table
+from sm_distributed_tpu.models.msm_jax import JaxBackend
 from sm_distributed_tpu.ops.fdr import FDR
-from sm_distributed_tpu.ops.imager_jax import extract_images, window_rank_grid
+from sm_distributed_tpu.ops.imager_jax import extract_images_flat_banded, flat_bound_ranks
 from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
 from sm_distributed_tpu.ops.metrics_jax import (
     isotope_image_correlation_batch,
     isotope_pattern_match_batch,
     measure_of_chaos_batch,
 )
-from sm_distributed_tpu.ops.quantize import quantize_window
 from sm_distributed_tpu.utils.config import DSConfig, SMConfig
 from sm_distributed_tpu.utils.logger import init_logger, logger
 
 
-def timeit(name, fn, *args, reps=5):
-    out = fn(*args)
+def timeit(name, fn, *args, reps=5, **kwargs):
+    out = fn(*args, **kwargs)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = fn(*args)
+        out = fn(*args, **kwargs)
     jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / reps
     logger.info("%-28s %8.2f ms", name, dt * 1e3)
     return out, dt
 
 
-def main():
+def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
+            cache_dir=None):
+    """Run the phase breakdown; returns {phase: seconds} for assertions."""
     init_logger()
-    cache_dir = Path(__file__).parent.parent / ".cache"
+    cache_dir = Path(cache_dir or Path(__file__).parent.parent / ".cache")
     path, truth = generate_synthetic_dataset(
-        cache_dir / "bench_ds", nrows=64, ncols=64,
-        formulas=FIXTURE_FORMULAS, present_fraction=0.6, noise_peaks=200, seed=7,
+        cache_dir / f"profile_ds_{nrows}x{ncols}", nrows=nrows, ncols=ncols,
+        formulas=FIXTURE_FORMULAS, present_fraction=0.6,
+        noise_peaks=noise_peaks, seed=7,
     )
     ds = SpectralDataset.from_imzml(path)
     ds_config = DSConfig.from_dict(
@@ -58,58 +64,74 @@ def main():
     )
     sm_config = SMConfig.from_dict(
         {"backend": "jax_tpu", "fdr": {"decoy_sample_size": 20},
-         "parallel": {"formula_batch": 512}}
+         "parallel": {"formula_batch": formula_batch}}
     )
 
     fdr = FDR(decoy_sample_size=20, target_adducts=("+H",), seed=42)
     assignment = fdr.decoy_adduct_selection(truth.formulas)
     pairs, flags = assignment.all_ion_tuples(truth.formulas, ("+H",))
-    calc = IsocalcWrapper(ds_config.isotope_generation, cache_dir=str(cache_dir / "isocalc"))
+    calc = IsocalcWrapper(ds_config.isotope_generation,
+                          cache_dir=str(cache_dir / "isocalc"))
     table = calc.pattern_table(pairs, flags)
 
     backend = JaxBackend(ds, ds_config, sm_config)
-    b = sm_config.parallel.formula_batch
+    b = backend.batch
     sub = _slice_table(table, 0, min(b, table.n_ions))
-    n, k = sub.n_ions, sub.max_peaks
+    k = sub.max_peaks
 
-    lo_q, hi_q = quantize_window(sub.mzs, ds_config.image_generation.ppm)
-    lo_p = np.zeros((b, k), np.int32); hi_p = np.zeros((b, k), np.int32)
-    ints_p = np.zeros((b, k), np.float32); nv_p = np.zeros(b, np.int32)
-    lo_p[:n], hi_p[:n] = lo_q, hi_q
-    ints_p[:n] = sub.ints; nv_p[:n] = sub.n_valid
-    grid, r_lo, r_hi = window_rank_grid(lo_p, hi_p)
-    logger.info("batch=%d ions, k=%d, grid=%d bins, cube=%s",
-                b, k, grid.shape[0], backend._mz_q.shape)
+    # the backend's own batch plan — identical host prep to score_batch
+    plan = backend._flat_plan(sub)
+    grid, _r_lo, _r_hi, ints_p, nv_p, chunks = plan
+    starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
+    pos = flat_bound_ranks(backend._mz_host, grid)
+    logger.info("batch=%d ions, k=%d, grid=%d bins, %d peaks resident, "
+                "gc_width=%d", b, k, grid.shape[0], backend._mz_host.size,
+                gc_width)
 
-    grid_d = jax.device_put(grid)
-    r_lo_d = jax.device_put(r_lo); r_hi_d = jax.device_put(r_hi)
-    ints_d = jax.device_put(ints_p); nv_d = jax.device_put(nv_p)
+    timings = {}
 
-    # full fused graph
-    _, t_full = timeit("fused full", backend._fn, backend._mz_q, backend._ints,
-                       grid_d, r_lo_d.reshape(b, k), r_hi_d.reshape(b, k),
-                       ints_d, nv_d)
+    # full fused graph, exactly as score_batch dispatches it
+    def fused():
+        out, _n = backend._dispatch(sub, plan)
+        return out
 
-    # extraction only
-    ext = jax.jit(extract_images)
-    imgs_flat, t_ext = timeit("extract_images", ext, backend._mz_q, backend._ints,
-                              grid_d, r_lo_d, r_hi_d)
-    imgs = imgs_flat.reshape(b, k, -1)[:, :, : ds.nrows * ds.ncols]
-    imgs = jax.device_put(np.asarray(imgs))
-    valid = np.arange(k)[None, :] < nv_p[:, None]
-    valid_d = jax.device_put(valid)
+    _, timings["fused_full"] = timeit("fused full", fused, reps=reps)
 
-    chaos_fn = jax.jit(partial(measure_of_chaos_batch, nrows=ds.nrows, ncols=ds.ncols))
-    _, t_chaos = timeit("chaos (30 levels)", chaos_fn, imgs[:, 0, :])
+    # extraction only (flat-banded, the production kernel)
+    ext = jax.jit(partial(extract_images_flat_banded,
+                          gc_width=backend._gc_width or gc_width,
+                          n_pixels=ds.n_pixels))
+    args = [jax.device_put(a) for a in (pos, starts, r_lo_loc, r_hi_loc, inv)]
+    imgs_flat, timings["extract"] = timeit(
+        "extract (flat-banded)", ext, backend._px_s, backend._in_s, *args,
+        reps=reps)
+    imgs = jax.device_put(np.asarray(imgs_flat).reshape(b, k, -1))
+    valid_d = jax.device_put(np.arange(k)[None, :] < nv_p[:, None])
+    ints_d = jax.device_put(ints_p)
+
+    chaos_fn = jax.jit(partial(measure_of_chaos_batch, nrows=ds.nrows,
+                               ncols=ds.ncols))
+    _, timings["chaos"] = timeit("chaos (30 levels)", chaos_fn, imgs[:, 0, :],
+                                 reps=reps)
 
     corr_fn = jax.jit(isotope_image_correlation_batch)
-    _, t_corr = timeit("correlation", corr_fn, imgs, ints_d, valid_d)
+    _, timings["correlation"] = timeit("correlation", corr_fn, imgs, ints_d,
+                                       valid_d, reps=reps)
 
-    pat_fn = jax.jit(lambda im, th, v: isotope_pattern_match_batch(im.sum(-1), th, v))
-    _, t_pat = timeit("pattern match", pat_fn, imgs, ints_d, valid_d)
+    pat_fn = jax.jit(lambda im, th, v: isotope_pattern_match_batch(
+        im.sum(-1), th, v))
+    _, timings["pattern"] = timeit("pattern match", pat_fn, imgs, ints_d,
+                                   valid_d, reps=reps)
 
+    parts = timings["extract"] + timings["chaos"] + timings["correlation"] \
+        + timings["pattern"]
     logger.info("sum of parts: %.2f ms (full %.2f ms)",
-                (t_ext + t_chaos + t_corr + t_pat) * 1e3, t_full * 1e3)
+                parts * 1e3, timings["fused_full"] * 1e3)
+    return timings
+
+
+def main():
+    profile()
 
 
 if __name__ == "__main__":

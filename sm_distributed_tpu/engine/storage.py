@@ -231,10 +231,13 @@ class SearchResultsStore:
         """Write annotations + metrics parquet, index annotations. Returns the
         dataset results dir.
 
-        Write order protects the previous successful job (ADVICE r1): files
-        land under temp names, the index replace runs as one transaction,
-        and only then do the renames swap the parquet in — a crash at any
-        earlier point leaves the old results intact.
+        Write order protects the previous successful job (ADVICE r1/r2):
+        files land under temp names and are atomically renamed into place
+        BEFORE the index replace commits — a crash before the renames leaves
+        the old results fully intact, and a crash between the renames and
+        the index transaction leaves new parquet with the old index rows,
+        which the next successful ``store`` (or a re-index) repairs; the
+        index never references annotations that are not on disk.
         """
         d = self.ds_dir(ds_id)
         tmps = []
@@ -246,9 +249,9 @@ class SearchResultsStore:
         tmp_t = d / "timings.json.tmp"
         tmp_t.write_text(json.dumps(bundle.timings, indent=2))
         tmps.append((tmp_t, d / "timings.json"))
-        n = self.index.index_ds(ds_id, job_id, bundle.annotations, ion_mzs)
         for tmp, dst in tmps:
             tmp.replace(dst)
+        n = self.index.index_ds(ds_id, job_id, bundle.annotations, ion_mzs)
         logger.info("stored %d annotations for ds %s under %s", n, ds_id, d)
         return d
 

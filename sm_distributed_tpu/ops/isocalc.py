@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import zipfile
 from dataclasses import dataclass
 from pathlib import Path
 
@@ -26,6 +27,7 @@ import numpy as np
 from . import elements
 from .formula import FormulaError, apply_adduct, parse_formula
 from ..utils.config import IsotopeGenerationConfig
+from ..utils.logger import logger
 
 # fine-structure pruning: drop states below this relative abundance
 _PRUNE_ABUNDANCE = 1e-10
@@ -130,9 +132,14 @@ def centroids(
     # window (one np.add.at instead of a Python loop per state)
     offs = np.arange(-half, half + 1)
     idx = centers[:, None] + offs[None, :]
+    # out-of-range window points are TRUNCATED (zero contribution), matching
+    # the per-state-window semantics — clamping alone would pile tail terms
+    # onto profile[0]/profile[-1] at wrong x offsets (ADVICE r2)
+    in_range = (idx >= 0) & (idx < npts)
     np.clip(idx, 0, npts - 1, out=idx)
     x = grid[idx] - mzs_fs[:, None]
-    contrib = abunds_fs[:, None] * np.exp(-0.5 * (x / isocalc_sigma) ** 2)
+    contrib = np.where(
+        in_range, abunds_fs[:, None] * np.exp(-0.5 * (x / isocalc_sigma) ** 2), 0.0)
     profile = np.zeros(npts)
     np.add.at(profile, idx, contrib)
 
@@ -238,7 +245,13 @@ class IsocalcWrapper:
         if self.cache_dir is not None:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
             for path in self._shard_paths():
-                self._cache.update(self._load_shard(path))
+                # tolerate (a) a concurrent compactor unlinking a shard
+                # between the glob and the load, (b) a corrupt/truncated
+                # shard from a crashed writer — skip it; entries recompute
+                try:
+                    self._cache.update(self._load_shard(path))
+                except (FileNotFoundError, zipfile.BadZipFile, ValueError, OSError) as e:
+                    logger.warning("skipping unreadable isocalc shard %s: %s", path, e)
 
     @staticmethod
     def _load_shard(path) -> dict:

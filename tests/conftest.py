@@ -9,6 +9,12 @@ before jax is imported anywhere, hence this top-of-conftest block.
 """
 
 import os
+import sys
+
+# plain `pytest` inserts tests/, not the repo root, on sys.path — the
+# `scripts` package (imported by test_golden_report / test_profile_script)
+# lives at the root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")

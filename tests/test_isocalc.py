@@ -161,3 +161,25 @@ def test_incremental_cache_shards(tmp_path):
     np.testing.assert_array_equal(t3.mzs[0], t1.mzs[0])
     # a pure cache-hit job writes no new shard
     assert len(list(tmp_path.glob("theor_peaks_*.npz"))) == 2
+
+def test_corrupt_cache_shard_skipped(tmp_path):
+    """A truncated/garbage shard (crashed old-format writer, or a concurrent
+    compactor racing the glob) must not brick every subsequent init — the
+    bad shard is skipped and its entries recompute (ADVICE r2)."""
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+
+    cfg = IsotopeGenerationConfig(adducts=("+H",))
+    c1 = IsocalcWrapper(cfg, cache_dir=tmp_path)
+    t1 = c1.pattern_table([("C6H12O6", "+H")])
+    key = c1._param_key()
+    # a leftover old-format tmp file that matches the shard glob but is not
+    # a valid zip
+    (tmp_path / f"theor_peaks_{key}.tmp.npz").write_bytes(b"not a zip")
+    # and a truncated real shard
+    shard = next(tmp_path.glob(f"theor_peaks_{key}_*.npz"))
+    data = shard.read_bytes()
+    shard.write_bytes(data[: len(data) // 2])
+
+    c2 = IsocalcWrapper(cfg, cache_dir=tmp_path)  # must not raise
+    t2 = c2.pattern_table([("C6H12O6", "+H")])    # recomputes fine
+    np.testing.assert_array_equal(t2.mzs, t1.mzs)

@@ -1,0 +1,13 @@
+"""Smoke test for scripts/profile_bench.py (ADVICE r2: the script had
+drifted from the backend's real signature and crashed at runtime — it now
+goes through JaxBackend._flat_plan/_dispatch, and this test pins that)."""
+
+from scripts.profile_bench import profile
+
+
+def test_profile_bench_runs_on_tiny_workload(tmp_path):
+    timings = profile(nrows=8, ncols=8, formula_batch=32, noise_peaks=10,
+                      reps=1, cache_dir=tmp_path)
+    assert set(timings) == {"fused_full", "extract", "chaos", "correlation",
+                            "pattern"}
+    assert all(t > 0 for t in timings.values())
