@@ -25,7 +25,7 @@ from sm_distributed_tpu.io.fixtures import FIXTURE_FORMULAS, generate_synthetic_
 from sm_distributed_tpu.models.msm_basic import _slice_table
 from sm_distributed_tpu.models.msm_jax import JaxBackend
 from sm_distributed_tpu.ops.fdr import FDR
-from sm_distributed_tpu.ops.imager_jax import extract_images_flat_banded, flat_bound_ranks
+from sm_distributed_tpu.ops.imager_jax import extract_images_flat_banded
 from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
 from sm_distributed_tpu.ops.metrics_jax import (
     isotope_image_correlation_batch,
@@ -36,27 +36,48 @@ from sm_distributed_tpu.utils.config import DSConfig, SMConfig
 from sm_distributed_tpu.utils.logger import init_logger, logger
 
 
+def _force(out):
+    """Force a host readback: block_until_ready through the tunneled TPU can
+    report fake-fast completions; an actual value fetch cannot.  Fetch ONE
+    element (a dependent tiny dispatch), not the whole array — a multi-GB
+    image block takes tens of seconds through the ~130 MB/s tunnel."""
+    for x in jax.tree.leaves(out):
+        np.asarray(x[(0,) * getattr(x, "ndim", 0)])
+
+
 def timeit(name, fn, *args, reps=5, **kwargs):
     out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+    _force(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+    _force(out)
     dt = (time.perf_counter() - t0) / reps
     logger.info("%-28s %8.2f ms", name, dt * 1e3)
     return out, dt
 
 
 def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
-            cache_dir=None):
-    """Run the phase breakdown; returns {phase: seconds} for assertions."""
+            cache_dir=None, n_formulas=None, batch_index=0):
+    """Run the phase breakdown; returns {phase: seconds} for assertions.
+
+    ``n_formulas``: expand the formula list like bench.py does (None = the
+    50 curated fixture formulas).  ``batch_index`` picks which formula batch
+    to profile — batch 0 holds every target window (all the signal), later
+    batches are decoy-dominated, so their cost profiles differ."""
+    from sm_distributed_tpu.io.fixtures import expand_formula_list
+
     init_logger()
     cache_dir = Path(cache_dir or Path(__file__).parent.parent / ".cache")
+    formulas = (expand_formula_list(n_formulas) if n_formulas
+                else FIXTURE_FORMULAS)
+    # n_formulas mode mirrors bench.py's exact fixture params, so reuse its
+    # cached dataset (a 256x256 generation costs ~4 min)
+    name = "bench_ds" if n_formulas else f"profile_ds_{nrows}x{ncols}"
     path, truth = generate_synthetic_dataset(
-        cache_dir / f"profile_ds_{nrows}x{ncols}", nrows=nrows, ncols=ncols,
-        formulas=FIXTURE_FORMULAS, present_fraction=0.6,
-        noise_peaks=noise_peaks, seed=7,
+        cache_dir / name, nrows=nrows, ncols=ncols,
+        formulas=formulas, present_fraction=0.6,
+        noise_peaks=noise_peaks, seed=7, reuse=True,
     )
     ds = SpectralDataset.from_imzml(path)
     ds_config = DSConfig.from_dict(
@@ -74,19 +95,20 @@ def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
                           cache_dir=str(cache_dir / "isocalc"))
     table = calc.pattern_table(pairs, flags)
 
-    backend = JaxBackend(ds, ds_config, sm_config)
+    backend = JaxBackend(ds, ds_config, sm_config, restrict_table=table)
     b = backend.batch
-    sub = _slice_table(table, 0, min(b, table.n_ions))
+    s0 = min(batch_index * b, max(table.n_ions - b, 0))
+    sub = _slice_table(table, s0, min(s0 + b, table.n_ions))
     k = sub.max_peaks
 
     # the backend's own batch plan — identical host prep to score_batch
     plan = backend._flat_plan(sub)
-    grid, _r_lo, _r_hi, ints_p, nv_p, chunks = plan
+    grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs = plan
     starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
-    pos = flat_bound_ranks(backend._mz_host, grid)
     logger.info("batch=%d ions, k=%d, grid=%d bins, %d peaks resident, "
-                "gc_width=%d", b, k, grid.shape[0], backend._mz_host.size,
-                gc_width)
+                "gc_width=%d, compact=%s (keep %s)",
+                b, k, grid.shape[0], backend._mz_host.size, gc_width,
+                backend._use_compaction(runs), runs[2] if runs else None)
 
     timings = {}
 
@@ -105,7 +127,9 @@ def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
     imgs_flat, timings["extract"] = timeit(
         "extract (flat-banded)", ext, backend._px_s, backend._in_s, *args,
         reps=reps)
-    imgs = jax.device_put(np.asarray(imgs_flat).reshape(b, k, -1))
+    # keep the (W, P) image block ON DEVICE — a host round-trip of this
+    # multi-GB array takes minutes through the tunnel
+    imgs = imgs_flat.reshape(b, k, -1)
     valid_d = jax.device_put(np.arange(k)[None, :] < nv_p[:, None])
     ints_d = jax.device_put(ints_p)
 

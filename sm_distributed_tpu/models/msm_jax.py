@@ -23,6 +23,8 @@ from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
 )
 from ..ops.imager_jax import (
+    batch_peak_runs,
+    compact_peaks,
     extract_images,
     extract_images_flat,
     extract_images_flat_banded,
@@ -66,6 +68,55 @@ def fused_score_fn_flat_banded(
     imgs = extract_images_flat_banded(
         pixel_sorted, int_sorted, pos, starts, r_lo_loc, r_hi_loc, inv,
         gc_width=gc_width, n_pixels=nrows * ncols)
+    # see fused_score_fn_flat_banded_compact: stop XLA from fusing the
+    # extraction into the metric consumers (measured 3x regression at 65k px)
+    imgs = jax.lax.optimization_barrier(imgs)
+    imgs = imgs.reshape(b, k, -1)
+    return batch_metrics(
+        imgs, theor_ints, n_valid, nrows, ncols, nlevels,
+        do_preprocessing=do_preprocessing, q=q,
+    )
+
+
+def fused_score_fn_flat_banded_compact(
+    pixel_sorted: jnp.ndarray,  # (N,) int32 resident peaks
+    int_sorted: jnp.ndarray,   # (N,) f32
+    run_pos: jnp.ndarray,      # (R_pad,) i32 kept-space run starts
+    run_delta: jnp.ndarray,    # (R_pad,) i32 per-run source-offset jumps
+    n_b: jnp.ndarray,          # () i32 kept peaks this batch
+    pos_b: jnp.ndarray,        # (G,) i32 kept-space bound ranks
+    starts: jnp.ndarray,       # (C,) chunk grid offsets
+    r_lo_loc: jnp.ndarray,     # (C, Wc)
+    r_hi_loc: jnp.ndarray,     # (C, Wc)
+    inv: jnp.ndarray,          # (B*K,)
+    theor_ints: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    *,
+    n_keep: int,
+    gc_width: int,
+    b: int,
+    k: int,
+    nrows: int,
+    ncols: int,
+    nlevels: int,
+    do_preprocessing: bool,
+    q: float,
+) -> jnp.ndarray:
+    """Flat-banded scoring with PER-BATCH peak compaction: only the peaks
+    inside this batch's window union are gathered and histogrammed, so the
+    scatter cost is per-hit, not per-resident-peak (the dominant cost in the
+    many-batch large-pixel regime — see ops/imager_jax.py compaction notes).
+    Images, and hence metrics, are bit-identical to the uncompacted path."""
+    px_b, in_b = compact_peaks(
+        pixel_sorted, int_sorted, run_pos, run_delta, n_b,
+        n_keep=n_keep, n_pixels=nrows * ncols)
+    imgs = extract_images_flat_banded(
+        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, inv,
+        gc_width=gc_width, n_pixels=nrows * ncols)
+    # materialize the image block before the metric consumers: without the
+    # barrier XLA's fusion across extraction->metrics regressed the step
+    # ~3x at 65k pixels (measured: 3.4 s fused vs ~1.1 s sum-of-parts)
+    imgs = jax.lax.optimization_barrier(imgs)
     imgs = imgs.reshape(b, k, -1)
     return batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
@@ -109,6 +160,29 @@ def fused_score_fn_chunked(
     )
 
 
+def to_numpy_global(arr) -> np.ndarray:
+    """Fetch a (possibly multi-process sharded) jax.Array to host numpy.
+
+    In a real multi-host run the per-batch output spans processes, so plain
+    ``np.asarray`` raises on the non-addressable shards.  The output is
+    replicated over the "pixels" mesh axis, so this process's devices
+    normally hold every formula shard — assemble them; if the local shards
+    don't cover the array (unusual mesh/process layout), fall back to an
+    explicit cross-process allgather."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    out = np.zeros(arr.shape, arr.dtype)
+    covered = np.zeros(arr.shape, dtype=bool)
+    for sh in arr.addressable_shards:
+        out[sh.index] = np.asarray(sh.data)
+        covered[sh.index] = True
+    if not covered.all():
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    return out
+
+
 def fetch_scored_batches(pending) -> list[np.ndarray]:
     """Fetch (device_out, n) pairs concurrently, preserving order.
 
@@ -124,9 +198,16 @@ def fetch_scored_batches(pending) -> list[np.ndarray]:
 
     if not pending:
         return []
+    if any(not getattr(p[0], "is_fully_addressable", True) for p in pending):
+        # multi-process outputs: to_numpy_global may fall back to a
+        # process_allgather COLLECTIVE, and threads could issue collectives
+        # in different orders on different processes (SPMD deadlock) —
+        # fetch sequentially, in pending order, on every process
+        return [to_numpy_global(p[0])[:p[1]].astype(np.float64)
+                for p in pending]
     with ThreadPoolExecutor(max_workers=min(8, len(pending))) as pool:
         return list(pool.map(
-            lambda p: np.asarray(p[0])[:p[1]].astype(np.float64), pending))
+            lambda p: to_numpy_global(p[0])[:p[1]].astype(np.float64), pending))
 
 
 class JaxBackend:
@@ -217,9 +298,15 @@ class JaxBackend:
             self._fn = jax.jit(
                 partial(fused_score_fn_flat_banded, **common),
                 static_argnames=("gc_width", "b", "k"))
-            # sticky band width: grows to the max seen so one executable
+            self._fn_c = jax.jit(
+                partial(fused_score_fn_flat_banded_compact, **common),
+                static_argnames=("n_keep", "gc_width", "b", "k"))
+            # sticky static shapes: grow to the max seen so one executable
             # serves (almost) all batches instead of recompiling per batch
             self._gc_width = 0
+            self._n_keep = 0          # compacted peak capacity
+            self._r_pad = 0           # compaction run-list capacity
+            self._compaction = sm_config.parallel.peak_compaction
 
     def _padded_windows(self, table: IsotopePatternTable):
         """Pad one batch's quantized windows to the static batch size
@@ -241,12 +328,36 @@ class JaxBackend:
         return grid, r_lo, r_hi, ints_p, nv_p
 
     def _flat_plan(self, table: IsotopePatternTable):
-        """Host prep of one batch for the flat-banded path: padded windows +
-        the window-chunk plan.  Computed once per table (score_batches builds
-        the plans up front to pre-size the band, then reuses them)."""
+        """Host prep of one batch for the flat-banded path: padded windows,
+        the window-chunk plan, bound ranks, and (unless disabled) the
+        per-batch peak-compaction runs.  Computed once per table
+        (score_batches builds the plans up front to pre-size the static
+        shapes, then reuses them)."""
         grid, r_lo, r_hi, ints_p, nv_p = self._padded_windows(table)
-        return (grid, r_lo, r_hi, ints_p, nv_p,
-                window_chunks(r_lo, r_hi, _BAND_WINDOWS))
+        chunks = window_chunks(r_lo, r_hi, _BAND_WINDOWS)
+        pos = flat_bound_ranks(self._mz_host, grid)
+        runs = None
+        if self._compaction != "off":
+            lo_q, hi_q = quantize_window(table.mzs, self.ppm)
+            runs = batch_peak_runs(self._mz_host, lo_q, hi_q, pos)
+        return (grid, r_lo, r_hi, ints_p, nv_p, chunks, pos, runs)
+
+    def _use_compaction(self, runs) -> bool:
+        """Compaction wins when a batch touches a minority of the resident
+        peaks (many-batch searches); on a near-full batch the extra gather
+        would only add cost.  'on'/'off' force the choice for tests."""
+        if runs is None or self._compaction == "off":
+            return False
+        if self._compaction == "on":
+            return True
+        return runs[2] <= 0.7 * self._mz_host.size
+
+    def _grow_compact_capacity(self, runs) -> None:
+        rnd = 1 << 16
+        self._n_keep = max(
+            self._n_keep, -(-max(runs[2], 1) // rnd) * rnd)
+        self._r_pad = max(
+            self._r_pad, -(-max(runs[0].size, 1) // 4096) * 4096)
 
     def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
         """Async: enqueue one padded batch on device, return (device_out, n)."""
@@ -264,14 +375,27 @@ class JaxBackend:
         else:
             if flat_plan is None:
                 flat_plan = self._flat_plan(table)
-            grid, _r_lo, _r_hi, ints_p, nv_p, chunks = flat_plan
+            _grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs = flat_plan
             starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
             self._gc_width = max(self._gc_width, gc_width)
-            pos = flat_bound_ranks(self._mz_host, grid)
-            args = [jax.device_put(a) for a in (
-                pos, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
-            out = self._fn(self._px_s, self._in_s, *args,
-                           gc_width=self._gc_width, b=b, k=k)
+            if self._use_compaction(runs):
+                run_pos, run_delta, n_b, pos_b = runs
+                self._grow_compact_capacity(runs)
+                rp = np.full(self._r_pad, self._n_keep, np.int32)
+                rp[: run_pos.size] = run_pos
+                rd = np.zeros(self._r_pad, np.int32)
+                rd[: run_delta.size] = run_delta
+                args = [jax.device_put(a) for a in (
+                    rp, rd, np.int32(n_b), pos_b,
+                    starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
+                out = self._fn_c(self._px_s, self._in_s, *args,
+                                 n_keep=self._n_keep,
+                                 gc_width=self._gc_width, b=b, k=k)
+            else:
+                args = [jax.device_put(a) for a in (
+                    pos, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
+                out = self._fn(self._px_s, self._in_s, *args,
+                               gc_width=self._gc_width, b=b, k=k)
         return out, n
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
@@ -329,7 +453,31 @@ class JaxBackend:
         if self.mz_chunk:
             return
         for t in tables:
-            self._gc_width = max(self._gc_width, self._flat_plan(t)[5][4])
+            plan = self._flat_plan(t)
+            self._gc_width = max(self._gc_width, plan[5][4])
+            if self._use_compaction(plan[7]):
+                self._grow_compact_capacity(plan[7])
+
+    def warmup(self, tables) -> None:
+        """Compile every executable ``tables`` will use, scoring ONE
+        representative batch per variant (plain vs peak-compaction — the
+        auto rule can pick either per batch).  Pre-sizes sticky static
+        shapes first so the warmed executables serve the whole stream."""
+        tables = list(tables)
+        if self.mz_chunk:
+            if tables:
+                self.score_batch(tables[0])
+            return
+        self.presize(tables)
+        reps, seen = [], set()
+        for t in tables:
+            kind = self._use_compaction(self._flat_plan(t)[7])
+            if kind not in seen:
+                seen.add(kind)
+                reps.append(t)
+            if len(seen) == 2:
+                break
+        self.score_batches(reps)
 
     def score_batches(self, tables) -> list[np.ndarray]:
         """Pipelined scoring: enqueue every batch before syncing any result
@@ -338,12 +486,14 @@ class JaxBackend:
         tables = list(tables)
         if self.mz_chunk:
             return fetch_scored_batches([self._dispatch(t) for t in tables])
-        # plan every batch up front: pre-sizes the band to the stream's max
-        # so ONE executable serves every batch (a mid-stream gc_width growth
-        # would recompile, ~15 s through a tunneled TPU), and each plan is
-        # reused by its dispatch instead of recomputed
+        # plan every batch up front: pre-sizes the static shapes (band width,
+        # compaction capacities) to the stream's max so ONE executable serves
+        # every batch (a mid-stream growth would recompile, ~15 s through a
+        # tunneled TPU), and each plan is reused by its dispatch
         plans = [self._flat_plan(t) for t in tables]
         for plan in plans:
             self._gc_width = max(self._gc_width, plan[5][4])
+            if self._use_compaction(plan[7]):
+                self._grow_compact_capacity(plan[7])
         return fetch_scored_batches(
             [self._dispatch(t, plan) for t, plan in zip(tables, plans)])

@@ -345,6 +345,94 @@ def restrict_flat_to_windows(
     return mz_k, px_k, in_k, n_eff
 
 
+# -- per-batch peak compaction ------------------------------------------------
+#
+# The window-union restriction (restrict_flat_to_windows) drops peaks outside
+# every window of the whole SEARCH, but the histogram scatter still touches
+# every resident peak once per BATCH — with T batches, each peak is scattered
+# T times while matching (typically) one batch's windows.  The reference has
+# no such waste: its searchsorted loop emits only hits [U, formula_imager_segm].
+# Per-batch compaction restores that property on TPU with static shapes:
+#
+# 1. Host, per batch: merge THIS batch's windows into disjoint m/z intervals
+#    and cut the sorted peak array at their bounds -> contiguous kept RUNS
+#    (run start + cumulative kept offset per run); n_b = total kept.
+# 2. Device: materialize the source index of every kept slot with one small
+#    scatter (one offset jump per run) + cumsum, then gather pixel/intensity
+#    rows.  A host-shipped index array would be ~N_b*4 B/batch through the
+#    tunnel; the run list is KBs.
+# 3. The bound ranks are re-based to kept space (exact integer arithmetic on
+#    the runs), and extraction proceeds unchanged on the compacted arrays.
+#
+# Exact: kept peaks are precisely those inside some window of the batch, so
+# the (pixel, bin, intensity) hit multiset — and every image bit — is
+# unchanged.  Scatter work drops from N_resident to ~N_resident/T per batch
+# (large formula DBs run tens of batches), which is what makes the large-P
+# regime (BASELINE #5) scatter-bound no more.
+
+
+def batch_peak_runs(
+    mz_host: np.ndarray,   # (N,) int32 sorted quantized m/z (resident peaks)
+    lo_q: np.ndarray,      # batch window lo bounds (any shape)
+    hi_q: np.ndarray,      # batch window hi bounds
+    pos: np.ndarray,       # (G,) int32 source-space bound ranks (flat_bound_ranks)
+) -> tuple[np.ndarray, np.ndarray, int, np.ndarray]:
+    """Host-side compaction plan: (run_kept_start (R,) i32, run_delta (R,) i32,
+    n_b, pos_b (G,) i32).
+
+    ``run_kept_start`` is each run's first index in kept space, ``run_delta``
+    the jump in (source - kept) offset at that index; ``pos_b`` re-bases the
+    grid bound ranks to kept space: #kept peaks strictly below the bound."""
+    flat = merged_window_bounds(lo_q, hi_q)
+    cuts = np.searchsorted(mz_host, flat.astype(mz_host.dtype), side="left")
+    starts, ends = cuts[0::2].astype(np.int64), cuts[1::2].astype(np.int64)
+    lens = ends - starts
+    keep = lens > 0
+    starts, lens = starts[keep], lens[keep]
+    if starts.size == 0:     # batch with no real windows (all padding)
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32), 0,
+                np.zeros(np.asarray(pos).shape, np.int32))
+    kept_start = np.zeros(starts.size + 1, dtype=np.int64)
+    np.cumsum(lens, out=kept_start[1:])
+    n_b = int(kept_start[-1])
+    # kept rank of a source rank s: walk back to the last run starting <= s;
+    # clamp inside the run (bounds between runs — possible only for empty
+    # padding windows — snap to the nearest run edge, which keeps their
+    # windows empty in kept space)
+    r = np.searchsorted(starts, pos, side="right") - 1
+    rc = np.clip(r, 0, None)
+    pos_b = np.where(
+        r < 0, 0,
+        kept_start[rc] + np.clip(pos - starts[rc], 0, lens[rc]))
+    offsets = starts - kept_start[:-1]
+    run_delta = np.diff(offsets, prepend=0)
+    return (kept_start[:-1].astype(np.int32), run_delta.astype(np.int32),
+            n_b, pos_b.astype(np.int32))
+
+
+def compact_peaks(
+    px_s: jnp.ndarray,      # (N,) int32 resident pixel rows
+    in_s: jnp.ndarray,      # (N,) f32 resident intensities
+    run_pos: jnp.ndarray,   # (R_pad,) i32 kept-space run starts (pad: >= n_keep)
+    run_delta: jnp.ndarray, # (R_pad,) i32 offset jumps (pad: 0)
+    n_b: jnp.ndarray,       # () i32 kept count this batch
+    *,
+    n_keep: int,
+    n_pixels: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side gather of the kept peak slots: (px_b, in_b), both (n_keep,).
+
+    Slots >= n_b are padding: pixel -> overflow row, intensity -> 0 (they
+    histogram into bin 0 of the overflow row, which is sliced off)."""
+    j = jnp.arange(n_keep, dtype=jnp.int32)
+    d = jnp.zeros(n_keep, jnp.int32).at[run_pos].add(run_delta, mode="drop")
+    src = jnp.clip(j + jnp.cumsum(d), 0, px_s.shape[0] - 1)
+    valid = j < n_b
+    px_b = jnp.where(valid, px_s[src], jnp.int32(n_pixels))
+    in_b = jnp.where(valid, in_s[src], jnp.float32(0.0))
+    return px_b, in_b
+
+
 # -- m/z-chunked extraction ---------------------------------------------------
 #
 # The reference segments the m/z range so each task's working set stays

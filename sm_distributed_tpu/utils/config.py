@@ -118,6 +118,10 @@ class ParallelConfig:
     # docs/PERF.md); batches pad to this so small jobs may prefer less
     formula_batch: int = 2048
     mz_chunk: int = 0                    # 0 = no m/z chunking inside the kernel
+    # per-batch peak compaction on the flat path: histogram only the peaks
+    # inside the current batch's window union (auto = on when the planned
+    # batches keep <70% of resident peaks; on/off force it)
+    peak_compaction: str = "auto"
     # multi-host (DCN) runtime — jax.distributed.initialize; the analog of
     # the reference's spark.master cluster address (SURVEY.md §5.8).  Env
     # vars SM_COORDINATOR / SM_NUM_PROCESSES / SM_PROCESS_ID override.
@@ -132,6 +136,10 @@ class ParallelConfig:
     # datasets with the same shapes skip the ~15-20s TPU compile entirely),
     # "off" = disabled, anything else = explicit directory
     compile_cache_dir: str = ""
+    # daemon service mode: how many datasets' parsed layouts + compiled
+    # backends stay resident across queue messages (LRU; 0 disables) —
+    # engine/residency.py
+    resident_datasets: int = 2
 
 
 @dataclass(frozen=True)
