@@ -93,3 +93,33 @@ def test_image_isolation_across_lane_packing(rng):
                                       interpret=True))
     assert (got[:7] == got[0]).all()
     assert got[7] == 0
+
+
+def test_wide_image_lean_kernel_matches_scipy(rng):
+    """512x512 exceeds the packed kernel's VMEM budget; the LEAN variant
+    (flags rematerialized per sweep) must cover it in-kernel with exact
+    scipy parity (VERDICT r2 item 3).  Interpret mode; a smaller lean-path
+    case keeps runtime sane while the geometry checks pin the real sizes."""
+    from sm_distributed_tpu.ops.chaos_pallas import (
+        _MAX_CELLS, _MAX_CELLS_LEAN, _pack_geometry, fits_vmem,
+    )
+
+    # geometry: 512x512 overflows the packed budget but fits the lean one
+    rp, cp, ib = _pack_geometry(512, 512, 512)
+    assert rp * cp * ib > _MAX_CELLS
+    rp, cp, ib = _pack_geometry(512, 512, 512, _MAX_CELLS_LEAN)
+    assert rp * cp * ib <= _MAX_CELLS_LEAN
+    assert fits_vmem(512, 512)
+    assert not fits_vmem(1024, 1024)       # beyond lean too -> scan fallback
+
+    # exact parity through the lean code path (forced by a shape past the
+    # packed budget; small enough for interpret mode)
+    r, c = 8, 16 * 1024  # rp*cp = 8*16384 = 131072 > _MAX_CELLS, <= lean
+    rp2, cp2, ib2 = _pack_geometry(r, c, 512)
+    assert rp2 * cp2 * ib2 > _MAX_CELLS
+    img = np.where(rng.random((2, r * c)) < 0.4,
+                   rng.random((2, r * c)), 0).astype(np.float32)
+    got = np.asarray(chaos_count_sums(img, nrows=r, ncols=c, nlevels=3,
+                                      interpret=True))
+    for i in range(2):
+        assert got[i] == _oracle_count_sum(img[i].reshape(r, c), 3)
