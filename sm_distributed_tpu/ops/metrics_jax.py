@@ -26,10 +26,14 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
-_BIG = jnp.int32(2**30)
+# numpy scalar, NOT jnp: a module-level jnp value would initialize the XLA
+# backend at import time, which forbids jax.distributed.initialize later
+# (multi-host processes import this module before calling initialize)
+_BIG = np.int32(2**30)
 
 
 def _seg_min_scan(vals: jnp.ndarray, resets: jnp.ndarray, axis: int,
@@ -164,24 +168,32 @@ def isotope_pattern_match_batch(
 
 
 def hotspot_clip_batch(images: jnp.ndarray, q: float) -> jnp.ndarray:
-    """Device-side hot-spot removal matching metrics_np.hotspot_clip: clip each
-    (ion, peak) image at the q-th linear-interpolated percentile of its
-    positive pixels; images with no positive pixels pass through.
+    """Device-side hot-spot removal, BIT-IDENTICAL to the numpy oracle's
+    ``hotspot_percentile_f32`` (the cross-backend cutoff definition): clip
+    each (ion, peak) image at the q-th linear-interpolated percentile of
+    its positive pixels; images with no positive pixels pass through.
 
-    ``images``: (..., P).  Masked percentile without dynamic shapes: sort the
-    row ascending (zeros first), the positives occupy the top m slots, and the
-    percentile sits at fractional position (P - m) + (q/100)*(m - 1).
-    """
+    ``images``: (..., P).  Masked percentile without dynamic shapes: sort
+    the row ascending (zeros first), the positives occupy the top m slots,
+    and the percentile's interpolation base sits at integer index
+    (P - m) + floor((q/100)*(m-1)).  The float arithmetic is the oracle's
+    exact single-op sequence — the integer index offset stays in integer
+    space (folding it into the float position changes rounding), and an
+    optimization barrier keeps XLA from contracting the final mul+add into
+    an FMA, whose different rounding would flip clipped-pixel bits."""
     p = images.shape[-1]
     srt = jnp.sort(images, axis=-1)
-    m = jnp.sum(images > 0, axis=-1)                       # (...,)
-    pos = (p - m) + (q / 100.0) * jnp.maximum(m - 1, 0)
-    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, p - 1)
-    hi = jnp.clip(lo + 1, 0, p - 1)
-    frac = (pos - lo.astype(pos.dtype))[..., None]
-    v_lo = jnp.take_along_axis(srt, lo[..., None], axis=-1)
-    v_hi = jnp.take_along_axis(srt, hi[..., None], axis=-1)
-    cutoff = v_lo + (v_hi - v_lo) * frac                   # (..., 1)
+    m = jnp.sum(images > 0, axis=-1).astype(jnp.int32)     # (...,)
+    t = np.float32(q) / np.float32(100.0)                  # host f32 constant
+    pos = t * jnp.maximum(m - 1, 0).astype(jnp.float32)    # one rounded mul
+    lo = jnp.floor(pos)                                    # exact
+    frac = (pos - lo)[..., None]                           # exact
+    i_lo = (p - m) + lo.astype(jnp.int32)                  # integer index math
+    i_hi = jnp.minimum(i_lo + 1, p - 1)
+    v_lo = jnp.take_along_axis(srt, jnp.clip(i_lo, 0, p - 1)[..., None], axis=-1)
+    v_hi = jnp.take_along_axis(srt, i_hi[..., None], axis=-1)
+    prod = jax.lax.optimization_barrier((v_hi - v_lo) * frac)
+    cutoff = v_lo + prod                                   # (..., 1)
     clipped = jnp.minimum(images, cutoff)
     return jnp.where((m > 0)[..., None], clipped, images)
 

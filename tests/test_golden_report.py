@@ -28,15 +28,31 @@ def golden():
     return json.loads(GOLDEN_PATH.read_text())
 
 
-@pytest.fixture(scope="module", params=["numpy_ref", "jax_tpu"])
-def bundle(request, tmp_path_factory):
-    td = tmp_path_factory.mktemp(f"golden_{request.param}")
-    return build_bundle(td, backend=request.param)
+@pytest.fixture(
+    scope="module",
+    params=[("numpy_ref", False), ("jax_tpu", False),
+            ("numpy_ref", True), ("jax_tpu", True)],
+    ids=["numpy", "jax", "numpy-preproc", "jax-preproc"],
+)
+def _bundle_and_section(request, tmp_path_factory):
+    backend, preproc = request.param
+    td = tmp_path_factory.mktemp(f"golden_{backend}_{int(preproc)}")
+    return build_bundle(td, backend=backend, preprocessing=preproc), preproc
 
 
-def test_metrics_match_golden(golden, bundle):
+@pytest.fixture(scope="module")
+def bundle(_bundle_and_section):
+    return _bundle_and_section[0]
+
+
+@pytest.fixture(scope="module")
+def section(_bundle_and_section, golden):
+    return golden["preprocessing"] if _bundle_and_section[1] else golden
+
+
+def test_metrics_match_golden(section, bundle):
     got = {(r.sf, r.adduct): r for r in bundle.all_metrics.itertuples()}
-    want = golden["all_metrics"]
+    want = section["all_metrics"]
     assert len(got) == len(want)
     for w in want:
         g = got[(w["sf"], w["adduct"])]
@@ -46,9 +62,9 @@ def test_metrics_match_golden(golden, bundle):
                 f"{col} drifted for {w['sf']}{w['adduct']}")
 
 
-def test_annotations_match_golden(golden, bundle):
+def test_annotations_match_golden(section, bundle):
     ann = bundle.annotations
-    want = golden["annotations"]
+    want = section["annotations"]
     assert [(r.sf, r.adduct) for r in ann.itertuples()] == [
         (w["sf"], w["adduct"]) for w in want], "annotation ORDER drifted"
     np.testing.assert_allclose(
