@@ -54,6 +54,20 @@ def resolve_distributed_settings(cfg: ParallelConfig) -> tuple[str, int, int]:
     return coord, n_proc, proc_id
 
 
+def initialize_kwargs(coord: str, n_proc: int, proc_id: int) -> dict:
+    """The exact kwargs handed to jax.distributed.initialize — factored out
+    so the mapping stays unit-testable without spawning processes (omitted
+    keys let JAX auto-discover on Cloud TPU pods)."""
+    kwargs: dict = {}
+    if coord:
+        kwargs["coordinator_address"] = coord
+    if n_proc > 1:
+        kwargs["num_processes"] = n_proc
+    if proc_id >= 0:
+        kwargs["process_id"] = proc_id
+    return kwargs
+
+
 def maybe_initialize_distributed(cfg: ParallelConfig) -> bool:
     """Initialize the multi-host runtime when configured; returns True when
     jax.distributed.initialize was called.  Idempotent; single-process
@@ -66,13 +80,7 @@ def maybe_initialize_distributed(cfg: ParallelConfig) -> bool:
         return True
     import jax
 
-    kwargs = {}
-    if coord:
-        kwargs["coordinator_address"] = coord
-    if n_proc > 1:
-        kwargs["num_processes"] = n_proc
-    if proc_id >= 0:
-        kwargs["process_id"] = proc_id
+    kwargs = initialize_kwargs(coord, n_proc, proc_id)
     logger.info("initializing multi-host runtime: %s", kwargs)
     jax.distributed.initialize(**kwargs)
     _initialized = True

@@ -29,25 +29,95 @@ def test_settings_env_overrides_config(monkeypatch):
     assert distributed.resolve_distributed_settings(cfg) == ("cfghost:1", 2, 0)
 
 
-def test_multiprocess_calls_initialize(monkeypatch):
-    calls = {}
+def test_initialize_kwargs_mapping():
+    """Fast coverage of the initialize kwargs mapping (the subprocess test
+    below covers the real call)."""
+    from sm_distributed_tpu.parallel.distributed import initialize_kwargs
 
-    def fake_init(**kwargs):
-        calls.update(kwargs)
+    assert initialize_kwargs("h0:8476", 2, 1) == {
+        "coordinator_address": "h0:8476", "num_processes": 2, "process_id": 1}
+    assert initialize_kwargs("", 4, -1) == {"num_processes": 4}
+    assert initialize_kwargs("h:1", 1, 0) == {
+        "coordinator_address": "h:1", "process_id": 0}
 
-    import jax
 
-    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
-    monkeypatch.setattr(distributed, "_initialized", False)
-    cfg = ParallelConfig(coordinator_address="h0:8476", num_processes=2, process_id=1)
-    assert distributed.maybe_initialize_distributed(cfg) is True
-    assert calls == {"coordinator_address": "h0:8476", "num_processes": 2,
-                     "process_id": 1}
-    # idempotent: second call does not re-initialize
-    calls.clear()
-    assert distributed.maybe_initialize_distributed(cfg) is True
-    assert calls == {}
-    monkeypatch.setattr(distributed, "_initialized", False)
+@pytest.mark.slow
+def test_two_process_distributed_real(tmp_path):
+    """REAL 2-process run (VERDICT r2 item 2) — no mocks: two subprocesses
+    jax.distributed.initialize over a localhost coordinator, build the
+    ("pixels", "formulas") mesh across 8 devices spanning both processes,
+    run ShardedJaxBackend.score_batch, and exercise divergent-checkpoint
+    resume agreement (_agree_resume_point).  The two processes must return
+    IDENTICAL bits (one SPMD program); vs the numpy oracle chaos is exact
+    and spatial/spectral agree to 1e-6 (the multi-process lowering fuses
+    f32 reductions differently than the single-process program)."""
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    with socket.socket() as s:       # free localhost port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = Path(__file__).parent / "distributed_worker.py"
+    # strip the TPU-plugin env: its sitecustomize registers a PJRT backend
+    # at interpreter boot, which forbids jax.distributed.initialize later
+    env_common = {
+        **{k: v for k, v in __import__("os").environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS",
+                        "PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN")},
+        "SM_COORDINATOR": f"127.0.0.1:{port}",
+        "SM_NUM_PROCESSES": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(tmp_path)],
+            env={**env_common, "SM_PROCESS_ID": str(pid)},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-4000:]}"
+        assert (tmp_path / f"ok_p{pid}.json").exists()
+
+    # cross-process sharded metrics == the numpy oracle, bit-exact
+    m0 = np.load(tmp_path / "metrics_p0.npy")
+    m1 = np.load(tmp_path / "metrics_p1.npy")
+    np.testing.assert_array_equal(m0, m1)
+
+    from sm_distributed_tpu.io.dataset import SpectralDataset
+    from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+    from sm_distributed_tpu.models.msm_basic import NumpyBackend, _slice_table
+    from sm_distributed_tpu.ops.fdr import FDR
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import DSConfig
+
+    path, truth = generate_synthetic_dataset(
+        tmp_path / "ds_ref", nrows=8, ncols=8, formulas=None,
+        present_fraction=0.5, noise_peaks=30, seed=17)
+    ds = SpectralDataset.from_imzml(path)
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+    formulas = list(truth.formulas)[:8]
+    fdr = FDR(decoy_sample_size=3, target_adducts=("+H",), seed=5)
+    assignment = fdr.decoy_adduct_selection(formulas)
+    pairs, flags = assignment.all_ion_tuples(formulas, ("+H",))
+    table = IsocalcWrapper(ds_config.isotope_generation).pattern_table(pairs, flags)
+    sub = _slice_table(table, 0, min(8, table.n_ions))
+    want = NumpyBackend(ds, ds_config).score_batch(sub)
+    np.testing.assert_array_equal(m0[: sub.n_ions, 0], want[:, 0])
+    np.testing.assert_allclose(m0[: sub.n_ions], want, atol=1e-6)
 
 
 def test_mesh_axis_validation_rejects_bad_negatives():
