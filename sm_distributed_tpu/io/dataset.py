@@ -71,13 +71,9 @@ class SpectralDataset:
 
     # -- construction ----------------------------------------------------
 
-    @classmethod
-    def from_arrays(
-        cls,
-        coords: np.ndarray,
-        spectra: list[tuple[np.ndarray, np.ndarray]],
-    ) -> "SpectralDataset":
-        """Build from raw (x, y) scan coords + per-spectrum (mzs, ints).
+    @staticmethod
+    def _pixel_grid(coords: np.ndarray, n_spectra: int):
+        """(nrows, ncols, pixel_inds, mask) from raw scan coordinates.
 
         Pixel-order normalization mirrors the reference's
         ``_define_pixels_order`` [U]: coordinates are mapped through their
@@ -85,7 +81,7 @@ class SpectralDataset:
         the dense pixel index is row-major ``row * ncols + col``.
         """
         coords = np.asarray(coords, dtype=np.int64)
-        if coords.ndim != 2 or coords.shape[1] != 2 or coords.shape[0] != len(spectra):
+        if coords.ndim != 2 or coords.shape[1] != 2 or coords.shape[0] != n_spectra:
             raise ValueError("coords must be (n_spectra, 2) matching spectra list")
         ux = np.unique(coords[:, 0])
         uy = np.unique(coords[:, 1])
@@ -95,20 +91,54 @@ class SpectralDataset:
         pixel_inds = row * ncols + col
         if np.unique(pixel_inds).size != pixel_inds.size:
             raise ValueError("duplicate scan coordinates map to the same pixel")
-
         mask = np.zeros(nrows * ncols, dtype=bool)
         mask[pixel_inds] = True
+        return nrows, ncols, pixel_inds, mask.reshape(nrows, ncols)
 
-        counts = np.zeros(nrows * ncols, dtype=np.int64)
+    @staticmethod
+    def _row_ptr(n_pixels: int, pixel_inds: np.ndarray, lens: np.ndarray):
+        counts = np.zeros(n_pixels, dtype=np.int64)
+        counts[pixel_inds] = lens
+        row_ptr = np.zeros(n_pixels + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_ptr[1:])
+        return row_ptr
+
+    @staticmethod
+    def _sort_rows_inplace(mzs_flat, ints_flat, row_ptr) -> None:
+        """Ensure ascending m/z within each CSR row, touching only rows that
+        need it.  Centroided imzML stores m/z ascending in practice, so the
+        vectorized violation scan usually finds nothing and this is O(N)
+        with no extra copies (vs a full-array lexsort at ~2.5x N bytes)."""
+        if mzs_flat.size < 2:
+            return
+        viol = mzs_flat[1:] < mzs_flat[:-1]
+        # a drop across a row boundary is not a violation
+        starts = row_ptr[1:-1]
+        viol[starts[(starts > 0) & (starts < mzs_flat.size)] - 1] = False
+        if not viol.any():
+            return
+        bad = np.unique(
+            np.searchsorted(row_ptr, np.nonzero(viol)[0] + 1, side="right") - 1)
+        for r in bad:
+            s, e = row_ptr[r], row_ptr[r + 1]
+            order = np.argsort(mzs_flat[s:e], kind="stable")
+            mzs_flat[s:e] = mzs_flat[s:e][order]
+            ints_flat[s:e] = ints_flat[s:e][order]
+
+    @classmethod
+    def from_arrays(
+        cls,
+        coords: np.ndarray,
+        spectra: list[tuple[np.ndarray, np.ndarray]],
+    ) -> "SpectralDataset":
+        """Build from raw (x, y) scan coords + per-spectrum (mzs, ints)."""
+        nrows, ncols, pixel_inds, mask = cls._pixel_grid(coords, len(spectra))
         lens = np.fromiter((len(m) for m, _ in spectra), dtype=np.int64,
                            count=len(spectra))
-        counts[pixel_inds] = lens
-        row_ptr = np.zeros(nrows * ncols + 1, dtype=np.int64)
-        np.cumsum(counts, out=row_ptr[1:])
+        row_ptr = cls._row_ptr(nrows * ncols, pixel_inds, lens)
 
-        # vectorized flat build (no per-spectrum Python loop; VERDICT r1
-        # weak #5): concatenate everything, then ONE lexsort keyed on
-        # (pixel, mz) groups peaks by dense pixel and m/z-sorts within
+        # vectorized flat build: concatenate everything, then ONE lexsort
+        # keyed on (pixel, mz) groups peaks by dense pixel and m/z-sorts
         mz_all = (np.concatenate([np.asarray(m, np.float64) for m, _ in spectra])
                   if spectra else np.empty(0, np.float64))
         int_all = (np.concatenate([np.asarray(i, np.float32) for _, i in spectra])
@@ -122,7 +152,7 @@ class SpectralDataset:
             nrows=nrows,
             ncols=ncols,
             pixel_inds=pixel_inds,
-            mask=mask.reshape(nrows, ncols),
+            mask=mask,
             mzs_flat=mzs_flat,
             ints_flat=ints_flat,
             row_ptr=row_ptr,
@@ -130,9 +160,45 @@ class SpectralDataset:
 
     @classmethod
     def from_imzml(cls, path: str | Path) -> "SpectralDataset":
+        """STREAMING ingest: peak host memory stays ~(12 bytes x total peaks)
+        plus one spectrum, instead of the eager build's ~4x that.
+
+        The reference streams spectrum-by-spectrum through its converter and
+        reader (``imzml_txt_converter``/``dataset_reader`` [U], SURVEY.md
+        #4-5); a >200k-pixel DESI slide (BASELINE #5) can exceed host RAM
+        under an eager whole-dataset materialization long before HBM matters.
+        Here: pass 1 reads per-spectrum peak COUNTS from the XML metadata
+        and preallocates the exact CSR arrays; pass 2 streams each
+        spectrum's bytes directly into its CSR slot (no intermediate list,
+        no concat, no full-array lexsort — per-row m/z order is verified
+        and repaired only where violated).  Bit-identical to from_arrays."""
         with ImzMLReader(path) as rd:
-            spectra = [rd.read_spectrum(i) for i in range(rd.n_spectra)]
-            return cls.from_arrays(rd.coordinates, spectra)
+            lens = rd.spectrum_lengths()
+            nrows, ncols, pixel_inds, mask = cls._pixel_grid(
+                rd.coordinates, rd.n_spectra)
+            row_ptr = cls._row_ptr(nrows * ncols, pixel_inds, lens)
+            total = int(lens.sum())
+            mzs_flat = np.empty(total, dtype=np.float64)
+            ints_flat = np.empty(total, dtype=np.float32)
+            for i in range(rd.n_spectra):
+                m, t = rd.read_spectrum(i)
+                s = row_ptr[pixel_inds[i]]
+                if m.size != lens[i]:
+                    raise ValueError(
+                        f"spectrum {i}: ibd length {m.size} != XML metadata "
+                        f"length {lens[i]}")
+                mzs_flat[s : s + m.size] = m
+                ints_flat[s : s + t.size] = t
+            cls._sort_rows_inplace(mzs_flat, ints_flat, row_ptr)
+            return cls(
+                nrows=nrows,
+                ncols=ncols,
+                pixel_inds=pixel_inds,
+                mask=mask,
+                mzs_flat=mzs_flat,
+                ints_flat=ints_flat,
+                row_ptr=row_ptr,
+            )
 
     # -- device layouts --------------------------------------------------
 

@@ -221,6 +221,13 @@ class ImzMLReader:
             raise ImzMLParseError(f"{self.ibd_path}: truncated read at offset {ref.offset}")
         return np.frombuffer(raw, dtype=ref.dtype)
 
+    def spectrum_lengths(self) -> np.ndarray:
+        """(n_spectra,) int64 peak counts WITHOUT touching the ibd data —
+        lengths come from the XML array metadata, which is what lets
+        ingestion preallocate exact CSR arrays and stream spectra into them
+        with bounded working memory (SpectralDataset.from_imzml)."""
+        return np.array([s.mz.length for s in self.spectra], dtype=np.int64)
+
     def read_spectrum(self, i: int) -> tuple[np.ndarray, np.ndarray]:
         """(mzs float64, intensities float32) of spectrum i."""
         s = self.spectra[i]

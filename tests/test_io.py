@@ -137,3 +137,52 @@ def test_synthetic_dataset_end_to_end(tmp_path):
         lo = np.searchsorted(np.sort(ds.mzs_flat), mz0 * (1 - 2e-6))
         hi = np.searchsorted(np.sort(ds.mzs_flat), mz0 * (1 + 2e-6))
         assert hi - lo > 10, f"{sf} signal missing from dataset"
+
+
+def test_streaming_ingest_bit_identical_and_bounded(tmp_path):
+    """from_imzml streams spectra into preallocated CSR arrays (VERDICT r2
+    item 5): bits identical to the eager from_arrays build, per-spectrum
+    lengths come from XML metadata without touching the ibd, and peak
+    working memory stays near the final array size (vs ~4x for the eager
+    concat+lexsort build)."""
+    import tracemalloc
+
+    rng = np.random.default_rng(9)
+    path = tmp_path / "s.imzML"
+    spectra, coords = [], []
+    with ImzMLWriter(path, continuous=False) as wr:
+        for i in range(60):                   # many spectra, ragged lengths
+            x, y = i % 10 + 1, i // 10 + 1
+            mzs = np.sort(rng.uniform(100, 900, size=200 + (i * 37) % 300))
+            ints = rng.exponential(5.0, size=len(mzs))
+            if i == 17:                       # one out-of-order spectrum
+                mzs = mzs[::-1].copy()
+            wr.add_spectrum(x, y, mzs, ints)
+            spectra.append((mzs, ints))
+            coords.append((x, y))
+
+    with ImzMLReader(path) as rd:
+        lens = rd.spectrum_lengths()
+        np.testing.assert_array_equal(
+            lens, [len(m) for m, _ in spectra])
+
+    eager = SpectralDataset.from_arrays(
+        np.array(coords), [(m.astype(np.float64), i.astype(np.float32))
+                           for m, i in spectra])
+    tracemalloc.start()
+    streamed = SpectralDataset.from_imzml(path)
+    _cur, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    np.testing.assert_array_equal(streamed.mzs_flat, eager.mzs_flat)
+    np.testing.assert_array_equal(streamed.ints_flat, eager.ints_flat)
+    np.testing.assert_array_equal(streamed.row_ptr, eager.row_ptr)
+    np.testing.assert_array_equal(streamed.pixel_inds, eager.pixel_inds)
+    np.testing.assert_array_equal(streamed.mask, eager.mask)
+    assert np.all(np.diff(streamed.mzs_flat[
+        streamed.row_ptr[0]:streamed.row_ptr[1]]) >= 0)
+
+    # bounded: peak tracked memory ~ the two final arrays (+1 small
+    # violation mask), far from the eager path's transient ~4x
+    final_bytes = streamed.mzs_flat.nbytes + streamed.ints_flat.nbytes
+    assert peak < 2.2 * final_bytes, (peak, final_bytes)
