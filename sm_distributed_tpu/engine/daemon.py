@@ -135,9 +135,19 @@ class QueueConsumer:
                 time.sleep(self.poll_interval)
 
 
-def annotate_callback(sm_config: SMConfig):
+def annotate_callback(sm_config: SMConfig, residency=None):
     """Build the daemon callback running a SearchJob per message
-    (mirrors scripts/sm_daemon.py wiring [U])."""
+    (mirrors scripts/sm_daemon.py wiring [U]).
+
+    A shared ``DatasetResidency`` keeps parsed datasets + compiled backends
+    warm across messages (the reference daemon's long-lived SparkContext
+    analog): a repeat job on the same dataset/shapes skips prepare and
+    compile.  ``parallel.resident_datasets = 0`` disables."""
+    if residency is None and sm_config.parallel.resident_datasets > 0:
+        from .residency import DatasetResidency
+
+        n = sm_config.parallel.resident_datasets
+        residency = DatasetResidency(max_datasets=n, max_backends=n)
 
     def cb(msg: dict) -> None:
         from .search_job import SearchJob
@@ -152,6 +162,7 @@ def annotate_callback(sm_config: SMConfig):
             ds_config=ds_config,
             sm_config=sm_config,
             formulas=msg.get("formulas"),
+            residency=residency,
         ).run(clean=bool(msg.get("clean")))
 
     return cb

@@ -1,0 +1,70 @@
+"""Multi-dataset residency for service mode (daemon).
+
+Reference: the daemon keeps ONE long-lived SparkContext across queue
+messages, so repeat jobs skip cluster spin-up [U] (SURVEY.md #16).  The
+TPU-native analog of that warm state is (a) the host-side CSR dataset
+layout (minutes of parse for a large slide) and (b) the backend object —
+device-resident flat peak arrays plus the compiled fused executable
+(~15-20 s compile + hundreds of MB of HBM transfer).  This cache keeps the
+last N of each across daemon messages with LRU eviction, so a second job on
+the same dataset/shapes skips prepare AND compile (ROADMAP item 3,
+VERDICT r2 item 7).
+
+Keys carry content identity, not just names: datasets key on the staged
+input manifest (so a restaged different file misses), backends key on the
+search fingerprint (dataset content + image config + batch partition +
+ion table) plus every backend-shaping parallel knob.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+from ..utils.logger import logger
+
+
+class _LRU:
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self.data: OrderedDict = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key, builder):
+        if self.maxsize <= 0:
+            self.misses += 1
+            return builder()
+        if key in self.data:
+            self.hits += 1
+            self.data.move_to_end(key)
+            return self.data[key]
+        self.misses += 1
+        val = builder()
+        self.data[key] = val
+        while len(self.data) > self.maxsize:
+            old_key, _old = self.data.popitem(last=False)
+            logger.info("residency: evicted %s", old_key[0] if old_key else old_key)
+        return val
+
+
+class DatasetResidency:
+    """LRU caches for host datasets and compiled backends across jobs."""
+
+    def __init__(self, max_datasets: int = 2, max_backends: int = 2):
+        self._datasets = _LRU(max_datasets)
+        self._backends = _LRU(max_backends)
+
+    def dataset(self, key, loader):
+        return self._datasets.get_or_build(key, loader)
+
+    def backend(self, key, builder):
+        return self._backends.get_or_build(key, builder)
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "dataset_hits": self._datasets.hits,
+            "dataset_misses": self._datasets.misses,
+            "backend_hits": self._backends.hits,
+            "backend_misses": self._backends.misses,
+        }

@@ -39,14 +39,21 @@ class SearchJob:
         sm_config: SMConfig | None = None,
         formulas: list[str] | None = None,
         profile_dir: str | None = None,
+        residency=None,
     ):
         self.ds_id = ds_id
         self.ds_name = ds_name
-        self.input_path = Path(input_path)
+        # URIs (file://, s3://) must NOT round-trip through Path — it
+        # collapses "://" to ":/" before the staging fetcher can parse it
+        s = str(input_path)
+        self.input_path: str | Path = s if "://" in s else Path(s)
         self.ds_config = ds_config
         self.sm_config = sm_config or SMConfig.get_conf()
         self.formulas = formulas      # explicit list overrides the mol DB
         self.profile_dir = profile_dir
+        # service mode: engine/residency.DatasetResidency shared across jobs
+        # keeps parsed datasets + compiled backends warm (SURVEY #16 analog)
+        self.residency = residency
         self.ledger = JobLedger(self.sm_config.storage.results_dir)
         self.store = SearchResultsStore(
             self.ledger,
@@ -78,7 +85,7 @@ class SearchJob:
             with phase_timer("stage_input", timings):
                 self.work_dir.copy_input_data(self.input_path)
             with phase_timer("read_dataset", timings):
-                ds = SpectralDataset.from_imzml(self.work_dir.imzml_path())
+                ds = self._read_dataset()
             logger.info(
                 "dataset %s: %dx%d px, %d spectra, %d peaks",
                 self.ds_id, ds.nrows, ds.ncols, ds.n_spectra, ds.n_peaks,
@@ -93,6 +100,7 @@ class SearchJob:
                 ds, formulas, self.ds_config, self.sm_config,
                 isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),
                 checkpoint_dir=str(self.work_dir.path),
+                backend_cache=self.residency,
             )
             bundle = search.search()
             if prof:
@@ -151,6 +159,20 @@ class SearchJob:
                 logger.info(
                     "job failed: keeping work dir %s for resume",
                     self.work_dir.path)
+
+    def _read_dataset(self) -> SpectralDataset:
+        """Parse the staged imzML — or reuse the residency cache's copy,
+        keyed on the staging manifest so a restaged DIFFERENT input misses."""
+        path = self.work_dir.imzml_path()
+        if self.residency is None:
+            return SpectralDataset.from_imzml(path)
+        import hashlib
+
+        manifest = self.work_dir.file("input.manifest.json")
+        content = manifest.read_text() if manifest.exists() else str(path)
+        key = (self.ds_id, hashlib.sha256(content.encode()).hexdigest())
+        return self.residency.dataset(
+            key, lambda: SpectralDataset.from_imzml(path))
 
     def _store_annotation_images(
         self, ds: SpectralDataset, search: MSMBasicSearch, bundle: SearchResultsBundle

@@ -1,11 +1,22 @@
-"""Work-dir staging with existence-check resume.
+"""Work-dir staging with pluggable fetchers and partial-fetch resume.
 
 Reference: ``sm/engine/work_dir.py::WorkDirManager`` [U] (SURVEY.md #3) stages
-input data on local FS or S3 and skips finished stages when their outputs
-already exist (the reference's poor-man's resume, SURVEY.md §5.4).  Here:
-local staging only (no S3 in scope offline), same skip-if-present semantics,
-plus a manifest recording the input fingerprint so a changed input busts the
-stale staging.
+input data on local FS or S3 (boto) and skips finished stages when their
+outputs already exist (the reference's poor-man's resume, SURVEY.md §5.4).
+
+Here staging goes through a ``Fetcher`` seam (VERDICT r2 item 8):
+
+- ``LocalFetcher`` — default, plain filesystem copies;
+- ``S3Fetcher`` — ``s3://bucket/key`` URIs via boto3 when available (this
+  build environment is offline, so it fails with guidance rather than
+  pretending);
+- any object with the two-method interface — tests inject a fake remote.
+
+Resume is PER FILE, not all-or-nothing: each file lands under a temp name
+and is renamed into place, files whose size+version already match the
+remote listing are skipped, and the manifest is written only after every
+file is staged — so a staging interrupted mid-transfer refetches only what
+is missing or stale.
 """
 
 from __future__ import annotations
@@ -17,56 +28,178 @@ from pathlib import Path
 from ..utils.logger import logger
 
 
-class WorkDirManager:
-    """Per-dataset scratch dir: ``<work_root>/<ds_id>/``."""
+class LocalFetcher:
+    """Filesystem staging: ``src`` is a file (imzML; the sibling .ibd comes
+    along) or a directory staged recursively with relative layout preserved
+    (basename flattening would silently overwrite same-named files)."""
 
-    def __init__(self, work_root: str | Path, ds_id: str):
-        self.path = Path(work_root) / ds_id
-        self.path.mkdir(parents=True, exist_ok=True)
-
-    def _fingerprint(self, src: Path) -> dict:
-        if src.is_file():
-            return {src.name: [src.stat().st_size, int(src.stat().st_mtime)]}
-        files = sorted(p for p in src.rglob("*") if p.is_file())
-        return {
-            str(p.relative_to(src)): [p.stat().st_size, int(p.stat().st_mtime)]
-            for p in files
-        }
-
-    def copy_input_data(self, input_path: str | Path) -> Path:
-        """Stage input (an imzML file or a directory holding the imzML/ibd
-        pair) into the work dir; skip if already staged and unchanged."""
-        src = Path(input_path)
+    def list_files(self, src: str | Path) -> dict[str, list]:
+        """{relpath: [size, version]} — the staging manifest entries."""
+        src = Path(src)
         if not src.exists():
             raise FileNotFoundError(f"input path does not exist: {src}")
-        dst = self.path / "input"
-        manifest = self.path / "input.manifest.json"
-        fp = self._fingerprint(src)
-        if dst.exists() and manifest.exists():
-            try:
-                if json.loads(manifest.read_text()) == fp:
-                    logger.info("work_dir: input already staged at %s, skipping", dst)
-                    return dst
-            except json.JSONDecodeError:
-                pass
-        if dst.exists():
-            shutil.rmtree(dst)
-        dst.mkdir(parents=True)
         if src.is_file():
-            shutil.copy2(src, dst / src.name)
+            out = {src.name: self._sig(src)}
             ibd = src.with_suffix(".ibd")
             if ibd.exists():
-                shutil.copy2(ibd, dst / ibd.name)
+                out[ibd.name] = self._sig(ibd)
+            return out
+        return {
+            str(p.relative_to(src)): self._sig(p)
+            for p in sorted(src.rglob("*")) if p.is_file()
+        }
+
+    @staticmethod
+    def _sig(p: Path) -> list:
+        st = p.stat()
+        return [st.st_size, str(int(st.st_mtime))]
+
+    def fetch_file(self, src: str | Path, rel: str, dst: Path) -> None:
+        src = Path(src)
+        # file source: rel is the file itself or its sibling .ibd
+        origin = src.with_name(rel) if src.is_file() else src / rel
+        shutil.copy2(origin, dst)
+
+
+class S3Fetcher:
+    """``s3://bucket/prefix`` staging via boto3 (the reference stages from
+    S3 with boto — ``WorkDir.s3_path/copy_input_data`` [U]).  boto3 is not
+    installed in the offline build image; constructing this fetcher without
+    it fails with guidance instead of at first use."""
+
+    def __init__(self):
+        try:
+            import boto3  # noqa: F401 — optional dependency
+        except ImportError as e:
+            raise ImportError(
+                "s3:// staging needs boto3, which is not available in this "
+                "environment; stage the input locally (any filesystem path) "
+                "or install boto3") from e
+        import boto3
+
+        self._s3 = boto3.client("s3")
+        self._keys: dict[str, str] = {}   # rel -> exact object key (per src)
+
+    @staticmethod
+    def _split(uri: str) -> tuple[str, str]:
+        rest = uri[len("s3://"):]
+        bucket, _, prefix = rest.partition("/")
+        return bucket, prefix
+
+    def list_files(self, src: str) -> dict[str, list]:
+        """An exact-key URI stages that one object; otherwise the prefix is
+        treated as a directory and listed '/'-terminated, so a sibling
+        prefix (ds1 vs ds10) can never leak into the listing.  Exact object
+        keys are recorded for fetch_file — relpaths are never re-derived."""
+        bucket, prefix = self._split(str(src))
+        paginator = self._s3.get_paginator("list_objects_v2")
+        exact: dict | None = None
+        for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
+            for obj in page.get("Contents", []):
+                if obj["Key"] == prefix:
+                    exact = obj
+        self._keys = {}
+        out: dict[str, list] = {}
+        if exact is not None:
+            rel = Path(prefix).name
+            self._keys[rel] = prefix
+            out[rel] = [exact["Size"], exact["ETag"].strip('"')]
+            return out
+        dir_prefix = prefix.rstrip("/") + "/" if prefix else ""
+        for page in paginator.paginate(Bucket=bucket, Prefix=dir_prefix):
+            for obj in page.get("Contents", []):
+                rel = obj["Key"][len(dir_prefix):]
+                # skip console-created zero-byte "folder marker" keys — as
+                # files they would shadow the directory and break mkdir
+                if not rel or rel.endswith("/"):
+                    continue
+                self._keys[rel] = obj["Key"]
+                out[rel] = [obj["Size"], obj["ETag"].strip('"')]
+        if not out:
+            raise FileNotFoundError(f"no objects under {src}")
+        return out
+
+    def fetch_file(self, src: str, rel: str, dst: Path) -> None:
+        bucket, _prefix = self._split(str(src))
+        key = self._keys.get(rel)
+        if key is None:
+            raise KeyError(f"{rel} not in the current listing for {src}")
+        self._s3.download_file(bucket, key, str(dst))
+
+
+def resolve_fetcher(input_path: str | Path):
+    """Pick a fetcher from the input URI scheme (plain paths -> local)."""
+    s = str(input_path)
+    if s.startswith("s3://"):
+        return S3Fetcher()
+    if "://" in s and not s.startswith("file://"):
+        raise ValueError(f"unsupported input scheme: {s}")
+    return LocalFetcher()
+
+
+class WorkDirManager:
+    """Per-dataset scratch dir: ``<work_root>/<ds_id>/``.
+
+    ``fetcher``: staging backend override (tests inject a fake remote);
+    default resolves from the input URI at copy_input_data time.
+    """
+
+    def __init__(self, work_root: str | Path, ds_id: str, fetcher=None):
+        self.path = Path(work_root) / ds_id
+        self.path.mkdir(parents=True, exist_ok=True)
+        self.fetcher = fetcher
+
+    def copy_input_data(self, input_path: str | Path) -> Path:
+        """Stage input into ``<work_dir>/input``; per-file skip-if-current.
+
+        A file is refetched only when absent or when its (size, version)
+        no longer matches the source listing; extraneous local files are
+        removed; the manifest commits the staging only once complete."""
+        fetcher = self.fetcher or resolve_fetcher(input_path)
+        s = str(input_path)
+        if s.startswith("file://"):
+            src: str | Path = Path(s[len("file://"):])   # plain local path
+        elif "://" in s:
+            src = s
         else:
-            # preserve relative layout — basename flattening would silently
-            # overwrite same-named files from different subdirs
-            for p in src.rglob("*"):
-                if p.is_file():
-                    out = dst / p.relative_to(src)
-                    out.parent.mkdir(parents=True, exist_ok=True)
-                    shutil.copy2(p, out)
-        manifest.write_text(json.dumps(fp))
-        logger.info("work_dir: staged %s -> %s", src, dst)
+            src = Path(s)
+        listing = fetcher.list_files(src)
+        dst = self.path / "input"
+        manifest = self.path / "input.manifest.json"
+        staged: dict = {}
+        if manifest.exists():
+            try:
+                staged = json.loads(manifest.read_text())
+            except json.JSONDecodeError:
+                staged = {}
+        if staged == listing and dst.exists():
+            logger.info("work_dir: input already staged at %s, skipping", dst)
+            return dst
+        manifest.unlink(missing_ok=True)  # staging no longer current
+        dst.mkdir(parents=True, exist_ok=True)
+        # drop extraneous files from a previous (different) staging
+        keep = {dst / rel for rel in listing}
+        for p in sorted(dst.rglob("*"), reverse=True):
+            if p.is_file() and p not in keep:
+                p.unlink()
+            elif p.is_dir() and not any(p.iterdir()):
+                p.rmdir()
+        fetched = 0
+        for rel, sig in listing.items():
+            out = dst / rel
+            if out.exists() and staged.get(rel) == sig:
+                continue                     # survived a partial staging
+            out.parent.mkdir(parents=True, exist_ok=True)
+            tmp = out.with_name(out.name + ".part")
+            fetcher.fetch_file(src, rel, tmp)
+            tmp.replace(out)
+            # commit per file: a crash mid-staging resumes from here
+            staged[rel] = sig
+            manifest.write_text(json.dumps(staged))
+            fetched += 1
+        manifest.write_text(json.dumps(listing))
+        logger.info("work_dir: staged %s -> %s (%d fetched, %d current)",
+                    src, dst, fetched, len(listing) - fetched)
         return dst
 
     def imzml_path(self) -> Path:

@@ -342,3 +342,123 @@ def test_jax_path_stores_device_images_without_cpu_reextraction(tmp_path, monkey
     want = real_extract(ds, table, ppm=3.0)
     np.testing.assert_array_equal(
         imgs.reshape(imgs.shape[0], imgs.shape[1], -1), want)
+
+
+class _FakeRemote:
+    """Fetcher test double simulating an object store (SURVEY #3 S3 seam):
+    in-memory {relpath: (bytes, version)}, optional failure injection after
+    N fetches to exercise resume-after-partial-fetch."""
+
+    def __init__(self, objects, fail_after=None):
+        self.objects = dict(objects)
+        self.fail_after = fail_after
+        self.fetch_log = []
+
+    def list_files(self, src):
+        return {rel: [len(data), ver] for rel, (data, ver) in self.objects.items()}
+
+    def fetch_file(self, src, rel, dst):
+        if self.fail_after is not None and len(self.fetch_log) >= self.fail_after:
+            raise ConnectionError(f"fake remote dropped while fetching {rel}")
+        self.fetch_log.append(rel)
+        dst.write_bytes(self.objects[rel][0])
+
+
+def test_work_dir_fake_remote_staging_and_partial_resume(tmp_path):
+    objs = {f"f{i}.bin": (bytes([i]) * (10 + i), f"v{i}") for i in range(6)}
+    # first attempt dies after 3 files
+    flaky = _FakeRemote(objs, fail_after=3)
+    wd = WorkDirManager(tmp_path / "work", "dsr", fetcher=flaky)
+    with pytest.raises(ConnectionError):
+        wd.copy_input_data("fake://bucket/ds")
+    assert len(flaky.fetch_log) == 3
+    # resume with a healthy connection: only the missing files transfer
+    healthy = _FakeRemote(objs)
+    wd2 = WorkDirManager(tmp_path / "work", "dsr", fetcher=healthy)
+    dst = wd2.copy_input_data("fake://bucket/ds")
+    assert sorted(healthy.fetch_log) == sorted(
+        set(objs) - set(flaky.fetch_log)), "already-staged files refetched"
+    for rel, (data, _v) in objs.items():
+        assert (dst / rel).read_bytes() == data
+    # steady state: nothing transfers
+    quiet = _FakeRemote(objs)
+    WorkDirManager(tmp_path / "work", "dsr", fetcher=quiet).copy_input_data(
+        "fake://bucket/ds")
+    assert quiet.fetch_log == []
+    # a changed remote version refetches exactly that file
+    objs2 = dict(objs)
+    objs2["f2.bin"] = (b"NEW", "v2b")
+    upd = _FakeRemote(objs2)
+    WorkDirManager(tmp_path / "work", "dsr", fetcher=upd).copy_input_data(
+        "fake://bucket/ds")
+    assert upd.fetch_log == ["f2.bin"]
+    assert (dst / "f2.bin").read_bytes() == b"NEW"
+
+
+def test_work_dir_s3_scheme_guidance(tmp_path):
+    from sm_distributed_tpu.engine.work_dir import resolve_fetcher
+
+    with pytest.raises(ImportError, match="boto3"):
+        resolve_fetcher("s3://bucket/ds")
+    with pytest.raises(ValueError, match="unsupported input scheme"):
+        resolve_fetcher("gopher://x")
+
+
+def test_daemon_residency_second_job_skips_prepare_and_compile(fixture_path, tmp_path):
+    """Service mode (VERDICT r2 item 7): a second queue message on the SAME
+    dataset/config must reuse the resident parsed dataset and the compiled
+    backend — residency cache hits, and the second job's read_dataset phase
+    collapses to ~zero in timings.json."""
+    from sm_distributed_tpu.engine.residency import DatasetResidency
+
+    path, truth = fixture_path
+    sm = SMConfig.from_dict({
+        "backend": "jax_tpu",
+        "fdr": {"decoy_sample_size": 2, "seed": 1},
+        "storage": {"results_dir": str(tmp_path / "res")},
+        "work_dir": str(tmp_path / "work"),
+        "parallel": {"formula_batch": 16, "pixels_axis": 1,
+                     "formulas_axis": 1},
+    })
+    residency = DatasetResidency(max_datasets=2, max_backends=2)
+    pub = QueuePublisher(tmp_path / "q")
+    msg = {"ds_id": "warm", "input_path": str(path),
+           "formulas": truth.formulas[:5],
+           "ds_config": {"isotope_generation": {"adducts": ["+H"]}}}
+    pub.publish(msg)
+    pub.publish(msg)
+    consumer = QueueConsumer(
+        tmp_path / "q", annotate_callback(sm, residency=residency))
+
+    consumer.run(max_messages=1)
+    t1 = json.loads((tmp_path / "res" / "warm" / "timings.json").read_text())
+    assert residency.stats == {"dataset_hits": 0, "dataset_misses": 1,
+                               "backend_hits": 0, "backend_misses": 1}
+    consumer.run(max_messages=1)
+    t2 = json.loads((tmp_path / "res" / "warm" / "timings.json").read_text())
+    assert residency.stats == {"dataset_hits": 1, "dataset_misses": 1,
+                               "backend_hits": 1, "backend_misses": 1}
+    # warm job: no parse — the phase is a cache lookup (generous absolute
+    # bound; the substantive reuse proof is the stats assert above)
+    assert t1["read_dataset"] > t2["read_dataset"]
+    assert t2["read_dataset"] < 0.1
+    # a DIFFERENT formula list must miss the backend cache (fingerprint)
+    pub.publish({**msg, "formulas": truth.formulas[:4]})
+    consumer.run(max_messages=1)
+    assert residency.stats["backend_misses"] == 2
+    assert residency.stats["dataset_hits"] == 2
+
+
+def test_work_dir_file_uri(tmp_path):
+    src = tmp_path / "in"
+    src.mkdir()
+    (src / "a.imzML").write_text("x")
+    wd = WorkDirManager(tmp_path / "work", "dsf")
+    dst = wd.copy_input_data(f"file://{src}")
+    assert (dst / "a.imzML").read_text() == "x"
+    # SearchJob must not round-trip URIs through Path (":" mangling)
+    job = SearchJob("u1", "u", f"file://{src}/a.imzML", DSConfig(),
+                    SMConfig.from_dict({
+                        "storage": {"results_dir": str(tmp_path / "res")},
+                        "work_dir": str(tmp_path / "work")}))
+    assert job.input_path == f"file://{src}/a.imzML"

@@ -164,3 +164,34 @@ def test_dryrun_multichip_driver_path():
     finally:
         sys.path.remove(repo_root)
     dryrun_multichip(4)
+
+
+def test_sharded_hbm_guard_and_mz_chunk_rejection(fixture_ds):
+    """The mesh path must fail EARLY with guidance (not OOM opaquely) when
+    the per-shard histogram scratch would blow HBM, and must refuse the
+    single-device-only mz_chunk knob instead of silently ignoring it."""
+    from sm_distributed_tpu.parallel.mesh import make_mesh
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
+
+    ds, truth = fixture_ds
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+
+    # oversize: huge formula batch on one formula shard -> per-shard scratch
+    # 4 * (p_loc+1) * 2*B*K explodes past 8 GiB
+    sm_big = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "parallel": {"formula_batch": 300_000_000, "pixels_axis": 4,
+                      "formulas_axis": 2}})
+    with pytest.raises(ValueError, match="per-shard histogram scratch"):
+        ShardedJaxBackend(ds, ds_config, sm_big,
+                          mesh=make_mesh(sm_big.parallel))
+
+    sm_chunk = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "parallel": {"formula_batch": 16, "pixels_axis": 4,
+                      "formulas_axis": 2, "mz_chunk": 64}})
+    with pytest.raises(ValueError, match="mz_chunk"):
+        ShardedJaxBackend(ds, ds_config, sm_chunk,
+                          mesh=make_mesh(sm_chunk.parallel))
