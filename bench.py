@@ -19,11 +19,26 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
 
 import numpy as np
+
+# module globals inherited by fork()ed floor workers (COW — the sorted peak
+# view is NOT re-built or copied per worker)
+_NP_BACKEND = None
+_NP_TABLE = None
+
+
+def _floor_worker(bounds: tuple[int, int]) -> int:
+    """Score one slice of the floor table in a forked worker."""
+    from sm_distributed_tpu.models.msm_basic import _slice_table
+
+    s, e = bounds
+    _NP_BACKEND.score_batch(_slice_table(_NP_TABLE, s, e))
+    return e - s
 
 
 def main() -> None:
@@ -39,6 +54,9 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--baseline-ions", type=int, default=210,
                     help="ions timed on numpy_ref (per-ion rate extrapolates)")
+    ap.add_argument("--floor-procs", type=int, default=0,
+                    help="processes for the multi-core numpy floor "
+                         "(0 = all cores)")
     args = ap.parse_args()
 
     from sm_distributed_tpu.io.dataset import SpectralDataset
@@ -90,25 +108,10 @@ def main() -> None:
     batches = [_slice_table(table, s, min(s + b, table.n_ions))
                for s in range(0, table.n_ions, b)]
 
-    # --- jax_tpu timing (compile excluded via warmup) -------------------
-    backend = make_backend("jax_tpu", ds, ds_config, sm_config, table=table)
-    t0 = time.perf_counter()
-    backend.score_batch(batches[0])
-    compile_dt = time.perf_counter() - t0
-    logger.info("jax warmup/compile: %.1fs", compile_dt)
-
-    # steady-state pipelined throughput: reps x batches enqueued as one
-    # stream, one sync at the end (matches a production-size formula DB where
-    # hundreds of batches flow through the one executable)
-    stream = batches * args.reps
-    n_scored = table.n_ions * args.reps
-    t0 = time.perf_counter()
-    backend.score_batches(stream)
-    jax_dt = time.perf_counter() - t0
-    jax_rate = n_scored / jax_dt
-    logger.info("jax_tpu: %d ions in %.2fs -> %.1f ions/s", n_scored, jax_dt, jax_rate)
-
-    # --- numpy_ref floor (spread subset, extrapolated per-ion) ----------
+    # --- numpy_ref floor FIRST (spread subset, extrapolated per-ion) ----
+    # The floor (incl. its fork pool) runs BEFORE any JAX work: forking a
+    # process that already holds a live PJRT/TPU client and runtime threads
+    # is unsupported and can deadlock the workers.
     np_backend = NumpyBackend(ds, ds_config)
     n_base = min(args.baseline_ions, table.n_ions)
     # even spread across the table -> same target/decoy mix as the full run
@@ -134,6 +137,55 @@ def main() -> None:
     logger.info("numpy_ref: %d ions in %.2fs (median of 3) -> %.1f ions/s",
                 sub.n_ions, np_dt, np_rate)
 
+    # --- multi-process floor: numpy_ref over a fork pool on ALL cores ---
+    # The north star compares against a Spark CLUSTER, not one core
+    # (BASELINE.md); reporting both floors makes "Xx one core, Yx an
+    # N-core node" defensible with measured numbers (VERDICT r2 item 9).
+    n_procs = max(1, args.floor_procs or os.cpu_count() or 1)
+    if n_procs > 1:
+        import multiprocessing as mp
+
+        global _NP_BACKEND, _NP_TABLE
+        _NP_BACKEND, _NP_TABLE = np_backend, sub
+        cut = np.linspace(0, sub.n_ions, n_procs + 1).astype(int)
+        chunks = [(int(cut[i]), int(cut[i + 1])) for i in range(n_procs)
+                  if cut[i + 1] > cut[i]]
+        ctx = mp.get_context("fork")   # COW-share the sorted peak view
+        t0 = time.perf_counter()
+        with ctx.Pool(n_procs) as pool:
+            done = sum(pool.map(_floor_worker, chunks))
+        mp_dt = time.perf_counter() - t0
+        mp_rate = done / mp_dt
+        logger.info("numpy_ref x%d procs: %d ions in %.2fs -> %.1f ions/s",
+                    n_procs, done, mp_dt, mp_rate)
+    else:
+        mp_rate = np_rate              # single-core host: the floors coincide
+        logger.info("single-core host: multi-process floor == single-core floor")
+
+    # --- jax_tpu timing (compile excluded via warmup) -------------------
+    backend = make_backend("jax_tpu", ds, ds_config, sm_config, table=table)
+    t0 = time.perf_counter()
+    # warm every executable the stream will use, one representative batch
+    # per variant (plain vs peak-compaction; JaxBackend.warmup inspects the
+    # plans rather than assuming which batches use which)
+    if hasattr(backend, "warmup"):
+        backend.warmup(batches)
+    else:
+        backend.score_batch(batches[0])
+    compile_dt = time.perf_counter() - t0
+    logger.info("jax warmup/compile: %.1fs", compile_dt)
+
+    # steady-state pipelined throughput: reps x batches enqueued as one
+    # stream, one sync at the end (matches a production-size formula DB where
+    # hundreds of batches flow through the one executable)
+    stream = batches * args.reps
+    n_scored = table.n_ions * args.reps
+    t0 = time.perf_counter()
+    backend.score_batches(stream)
+    jax_dt = time.perf_counter() - t0
+    jax_rate = n_scored / jax_dt
+    logger.info("jax_tpu: %d ions in %.2fs -> %.1f ions/s", n_scored, jax_dt, jax_rate)
+
     print(json.dumps({
         "metric": "ions_scored_per_sec_per_chip",
         "value": round(jax_rate, 2),
@@ -141,6 +193,9 @@ def main() -> None:
         "vs_baseline": round(jax_rate / np_rate, 2),
         "numpy_floor_ions_per_s": round(np_rate, 2),
         "numpy_floor_n_ions": int(sub.n_ions),
+        "floor_procs": int(n_procs),
+        "numpy_floor_multiproc_ions_per_s": round(mp_rate, 2),
+        "vs_baseline_multiproc": round(jax_rate / mp_rate, 2),
         "compile_s": round(compile_dt, 2),
         "n_ions": int(table.n_ions),
         "n_pixels": int(ds.n_pixels),
