@@ -7,10 +7,17 @@ the fused extract+score XLA graph — ion-image extraction + MSM metrics
 workload (the measured stand-in for the reference's Spark executor; the
 reference publishes no numbers — SURVEY.md §6, BASELINE.json "published": {}).
 
-The numpy floor is measured over >=200 ions drawn evenly across the ion
-table (targets AND decoys, matching the mix the jax path scores), and
-per-phase numbers (compile, scoring, floor) are separate JSON fields
-(VERDICT r1 item 10).
+Two configs run by default and land in the ONE JSON line:
+
+- headline: 64x64 px, 250 formulas (the round-over-round comparison case);
+- ``scale``: 256x256 px, 500 formulas, ~70M peaks — the BASELINE #5
+  (large-pixel DESI) regime, the round-2 weak spot (VERDICT r2 item 1).
+
+The numpy floor is measured over >=200 ions drawn evenly across each ion
+table (targets AND decoys), single-core AND over a fork pool on all cores
+(this container has one core, so the two coincide here).  All floor pools
+fork BEFORE any JAX work — forking after a PJRT client exists is
+unsupported and can deadlock.
 
 Prints ONE JSON line on stdout; all logging goes to stderr.
 """
@@ -41,6 +48,178 @@ def _floor_worker(bounds: tuple[int, int]) -> int:
     return e - s
 
 
+from dataclasses import dataclass  # noqa: E402
+
+
+@dataclass
+class BenchConfig:
+    name: str
+    nrows: int
+    ncols: int
+    n_formulas: int
+    formula_batch: int
+    decoy_sample_size: int
+    reps: int
+    baseline_ions: int
+
+
+def prepare(cfg: BenchConfig, cache_dir: Path):
+    """Dataset + ion table + batches + numpy backend — NO jax involved."""
+    from sm_distributed_tpu.io.dataset import SpectralDataset
+    from sm_distributed_tpu.io.fixtures import (
+        expand_formula_list,
+        generate_synthetic_dataset,
+    )
+    from sm_distributed_tpu.models.msm_basic import NumpyBackend, _slice_table
+    from sm_distributed_tpu.ops.fdr import FDR
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper, IsotopePatternTable
+    from sm_distributed_tpu.utils.config import DSConfig
+    from sm_distributed_tpu.utils.logger import logger
+
+    t0 = time.perf_counter()
+    formulas = expand_formula_list(cfg.n_formulas)
+    work_dir = cache_dir / f"bench_ds_{cfg.nrows}x{cfg.ncols}_f{cfg.n_formulas}"
+    path, truth = generate_synthetic_dataset(
+        work_dir, nrows=cfg.nrows, ncols=cfg.ncols,
+        formulas=formulas, present_fraction=0.6, noise_peaks=200, seed=7,
+        reuse=True,
+    )
+    ds = SpectralDataset.from_imzml(path)
+    logger.info("[%s] dataset: %dx%d px, %d peaks (%.1fs)",
+                cfg.name, ds.nrows, ds.ncols, ds.n_peaks,
+                time.perf_counter() - t0)
+
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+    fdr = FDR(decoy_sample_size=cfg.decoy_sample_size,
+              target_adducts=("+H",), seed=42)
+    assignment = fdr.decoy_adduct_selection(truth.formulas)
+    pairs, flags = assignment.all_ion_tuples(truth.formulas, ("+H",))
+    calc = IsocalcWrapper(ds_config.isotope_generation,
+                          cache_dir=str(cache_dir / "isocalc"))
+    t0 = time.perf_counter()
+    table = calc.pattern_table(pairs, flags)
+    isocalc_dt = time.perf_counter() - t0
+    logger.info("[%s] isotope patterns: %d ions (%.1fs)",
+                cfg.name, table.n_ions, isocalc_dt)
+
+    b = cfg.formula_batch
+    batches = [_slice_table(table, s, min(s + b, table.n_ions))
+               for s in range(0, table.n_ions, b)]
+    # floor subset: even spread across the table -> same target/decoy mix
+    n_base = min(cfg.baseline_ions, table.n_ions)
+    sel = np.unique(np.linspace(0, table.n_ions - 1, n_base).astype(int))
+    sub = IsotopePatternTable(
+        sfs=[table.sfs[i] for i in sel],
+        adducts=[table.adducts[i] for i in sel],
+        mzs=table.mzs[sel], ints=table.ints[sel],
+        n_valid=table.n_valid[sel], targets=table.targets[sel],
+    )
+    np_backend = NumpyBackend(ds, ds_config)
+    return dict(ds=ds, ds_config=ds_config, table=table, batches=batches,
+                sub=sub, np_backend=np_backend, isocalc_dt=isocalc_dt)
+
+
+def measure_floor(cfg: BenchConfig, prep: dict, n_procs: int) -> dict:
+    """Single-core (median of 3) + fork-pool floors — still no jax."""
+    from sm_distributed_tpu.models.msm_basic import _slice_table
+    from sm_distributed_tpu.utils.logger import logger
+
+    np_backend, sub = prep["np_backend"], prep["sub"]
+    np_backend.score_batch(_slice_table(prep["table"], 0, 2))  # warm caches
+    np_dts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np_backend.score_batch(sub)
+        np_dts.append(time.perf_counter() - t0)
+    np_dt = sorted(np_dts)[1]
+    np_rate = sub.n_ions / np_dt
+    logger.info("[%s] numpy_ref: %d ions in %.2fs (median of 3) -> %.1f ions/s",
+                cfg.name, sub.n_ions, np_dt, np_rate)
+
+    if n_procs > 1:
+        import multiprocessing as mp
+
+        global _NP_BACKEND, _NP_TABLE
+        _NP_BACKEND, _NP_TABLE = np_backend, sub
+        # every worker scores the FULL floor table (>= a single-core
+        # workload per worker, so fork/dispatch overhead can't dominate);
+        # pool startup is excluded and the timing is median-of-3 like the
+        # single-core floor
+        jobs = [(0, sub.n_ions)] * n_procs
+        ctx = mp.get_context("fork")   # COW-share the sorted peak view
+        with ctx.Pool(n_procs) as pool:
+            pool.map(_floor_worker, [(0, 1)] * n_procs)   # warm the pool
+            mp_dts = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                done = sum(pool.map(_floor_worker, jobs))
+                mp_dts.append(time.perf_counter() - t0)
+        mp_dt = sorted(mp_dts)[1]
+        mp_rate = done / mp_dt
+        logger.info("[%s] numpy_ref x%d procs: %d ions in %.2fs (median of 3)"
+                    " -> %.1f ions/s", cfg.name, n_procs, done, mp_dt, mp_rate)
+    else:
+        mp_rate = np_rate              # single-core host: floors coincide
+        logger.info("[%s] single-core host: multi-process floor == "
+                    "single-core floor", cfg.name)
+    return dict(np_rate=np_rate, mp_rate=mp_rate, n_procs=n_procs,
+                floor_n_ions=int(sub.n_ions))
+
+
+def measure_jax(cfg: BenchConfig, prep: dict) -> dict:
+    """Warm every executable variant, then time the pipelined stream."""
+    from sm_distributed_tpu.models.msm_basic import make_backend
+    from sm_distributed_tpu.utils.config import SMConfig
+    from sm_distributed_tpu.utils.logger import logger
+
+    sm_config = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "fdr": {"decoy_sample_size": cfg.decoy_sample_size},
+         "parallel": {"formula_batch": cfg.formula_batch}})
+    backend = make_backend("jax_tpu", prep["ds"], prep["ds_config"],
+                           sm_config, table=prep["table"])
+    batches = prep["batches"]
+    t0 = time.perf_counter()
+    if hasattr(backend, "warmup"):
+        backend.warmup(batches)
+    else:
+        backend.score_batch(batches[0])
+    compile_dt = time.perf_counter() - t0
+    logger.info("[%s] jax warmup/compile: %.1fs", cfg.name, compile_dt)
+
+    # steady-state pipelined throughput: reps x batches enqueued as one
+    # stream, one sync at the end (a production formula DB streams hundreds
+    # of batches through the same executables)
+    stream = batches * cfg.reps
+    n_scored = prep["table"].n_ions * cfg.reps
+    t0 = time.perf_counter()
+    backend.score_batches(stream)
+    jax_dt = time.perf_counter() - t0
+    jax_rate = n_scored / jax_dt
+    logger.info("[%s] jax_tpu: %d ions in %.2fs -> %.1f ions/s",
+                cfg.name, n_scored, jax_dt, jax_rate)
+    return dict(jax_rate=jax_rate, compile_dt=compile_dt)
+
+
+def report(prep: dict, floor: dict, jaxr: dict) -> dict:
+    return {
+        "value": round(jaxr["jax_rate"], 2),
+        "vs_baseline": round(jaxr["jax_rate"] / floor["np_rate"], 2),
+        "numpy_floor_ions_per_s": round(floor["np_rate"], 2),
+        "numpy_floor_n_ions": floor["floor_n_ions"],
+        "floor_procs": floor["n_procs"],
+        "numpy_floor_multiproc_ions_per_s": round(floor["mp_rate"], 2),
+        "vs_baseline_multiproc": round(jaxr["jax_rate"] / floor["mp_rate"], 2),
+        "compile_s": round(jaxr["compile_dt"], 2),
+        "n_ions": int(prep["table"].n_ions),
+        "n_pixels": int(prep["ds"].n_pixels),
+        "pixels_per_s": round(jaxr["jax_rate"] * prep["ds"].n_pixels, 0),
+        "isocalc_s": round(prep["isocalc_dt"], 2),
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nrows", type=int, default=64)
@@ -57,151 +236,41 @@ def main() -> None:
     ap.add_argument("--floor-procs", type=int, default=0,
                     help="processes for the multi-core numpy floor "
                          "(0 = all cores)")
+    ap.add_argument("--skip-scale", action="store_true",
+                    help="skip the 256x256/500-formula scale case")
     args = ap.parse_args()
 
-    from sm_distributed_tpu.io.dataset import SpectralDataset
-    from sm_distributed_tpu.io.fixtures import expand_formula_list, generate_synthetic_dataset
-    from sm_distributed_tpu.models.msm_basic import NumpyBackend, _slice_table, make_backend
-    from sm_distributed_tpu.ops.fdr import FDR
-    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
-    from sm_distributed_tpu.utils.config import DSConfig, SMConfig
-    from sm_distributed_tpu.utils.logger import init_logger, logger
+    from sm_distributed_tpu.utils.logger import init_logger
 
     init_logger()
     cache_dir = Path(__file__).parent / ".cache"
-    work_dir = cache_dir / "bench_ds"
-
-    t0 = time.perf_counter()
-    bench_formulas = expand_formula_list(args.n_formulas)
-    path, truth = generate_synthetic_dataset(
-        work_dir, nrows=args.nrows, ncols=args.ncols,
-        formulas=bench_formulas, present_fraction=0.6, noise_peaks=200, seed=7,
-        reuse=True,
-    )
-    ds = SpectralDataset.from_imzml(path)
-    logger.info("dataset: %dx%d px, %d peaks (%.1fs)",
-                ds.nrows, ds.ncols, ds.n_peaks, time.perf_counter() - t0)
-
-    ds_config = DSConfig.from_dict(
-        {"isotope_generation": {"adducts": ["+H"]},
-         "image_generation": {"ppm": 3.0}}
-    )
-    sm_config = SMConfig.from_dict(
-        {"backend": "jax_tpu",
-         "fdr": {"decoy_sample_size": args.decoy_sample_size},
-         "parallel": {"formula_batch": args.formula_batch}}
-    )
-    SMConfig.set(sm_config)
-
-    # Full target+decoy ion table (the realistic scoring workload).
-    fdr = FDR(decoy_sample_size=args.decoy_sample_size,
-              target_adducts=("+H",), seed=42)
-    assignment = fdr.decoy_adduct_selection(truth.formulas)
-    pairs, flags = assignment.all_ion_tuples(truth.formulas, ("+H",))
-    calc = IsocalcWrapper(ds_config.isotope_generation, cache_dir=str(cache_dir / "isocalc"))
-    t0 = time.perf_counter()
-    table = calc.pattern_table(pairs, flags)
-    isocalc_dt = time.perf_counter() - t0
-    logger.info("isotope patterns: %d ions (%.1fs)", table.n_ions, isocalc_dt)
-
-    b = args.formula_batch
-    batches = [_slice_table(table, s, min(s + b, table.n_ions))
-               for s in range(0, table.n_ions, b)]
-
-    # --- numpy_ref floor FIRST (spread subset, extrapolated per-ion) ----
-    # The floor (incl. its fork pool) runs BEFORE any JAX work: forking a
-    # process that already holds a live PJRT/TPU client and runtime threads
-    # is unsupported and can deadlock the workers.
-    np_backend = NumpyBackend(ds, ds_config)
-    n_base = min(args.baseline_ions, table.n_ions)
-    # even spread across the table -> same target/decoy mix as the full run
-    sel = np.linspace(0, table.n_ions - 1, n_base).astype(int)
-    sel = np.unique(sel)
-    from sm_distributed_tpu.ops.isocalc import IsotopePatternTable
-    sub = IsotopePatternTable(
-        sfs=[table.sfs[i] for i in sel],
-        adducts=[table.adducts[i] for i in sel],
-        mzs=table.mzs[sel], ints=table.ints[sel],
-        n_valid=table.n_valid[sel], targets=table.targets[sel],
-    )
-    np_backend.score_batch(_slice_table(table, 0, 2))  # warm caches
-    # median of 3: the shared-host floor varies ~±20% run to run, and
-    # vs_baseline should not ride that noise
-    np_dts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np_backend.score_batch(sub)
-        np_dts.append(time.perf_counter() - t0)
-    np_dt = sorted(np_dts)[1]
-    np_rate = sub.n_ions / np_dt
-    logger.info("numpy_ref: %d ions in %.2fs (median of 3) -> %.1f ions/s",
-                sub.n_ions, np_dt, np_rate)
-
-    # --- multi-process floor: numpy_ref over a fork pool on ALL cores ---
-    # The north star compares against a Spark CLUSTER, not one core
-    # (BASELINE.md); reporting both floors makes "Xx one core, Yx an
-    # N-core node" defensible with measured numbers (VERDICT r2 item 9).
     n_procs = max(1, args.floor_procs or os.cpu_count() or 1)
-    if n_procs > 1:
-        import multiprocessing as mp
 
-        global _NP_BACKEND, _NP_TABLE
-        _NP_BACKEND, _NP_TABLE = np_backend, sub
-        cut = np.linspace(0, sub.n_ions, n_procs + 1).astype(int)
-        chunks = [(int(cut[i]), int(cut[i + 1])) for i in range(n_procs)
-                  if cut[i + 1] > cut[i]]
-        ctx = mp.get_context("fork")   # COW-share the sorted peak view
-        t0 = time.perf_counter()
-        with ctx.Pool(n_procs) as pool:
-            done = sum(pool.map(_floor_worker, chunks))
-        mp_dt = time.perf_counter() - t0
-        mp_rate = done / mp_dt
-        logger.info("numpy_ref x%d procs: %d ions in %.2fs -> %.1f ions/s",
-                    n_procs, done, mp_dt, mp_rate)
-    else:
-        mp_rate = np_rate              # single-core host: the floors coincide
-        logger.info("single-core host: multi-process floor == single-core floor")
+    head = BenchConfig("headline", args.nrows, args.ncols, args.n_formulas,
+                       args.formula_batch, args.decoy_sample_size,
+                       args.reps, args.baseline_ions)
+    configs = [head]
+    # the scale case only rides along on a default headline run (an ad-hoc
+    # --nrows 256 run IS a scale run already)
+    if not args.skip_scale and (args.nrows, args.ncols) == (64, 64):
+        configs.append(BenchConfig(
+            "scale", 256, 256, 500, args.formula_batch,
+            args.decoy_sample_size, args.reps, args.baseline_ions))
 
-    # --- jax_tpu timing (compile excluded via warmup) -------------------
-    backend = make_backend("jax_tpu", ds, ds_config, sm_config, table=table)
-    t0 = time.perf_counter()
-    # warm every executable the stream will use, one representative batch
-    # per variant (plain vs peak-compaction; JaxBackend.warmup inspects the
-    # plans rather than assuming which batches use which)
-    if hasattr(backend, "warmup"):
-        backend.warmup(batches)
-    else:
-        backend.score_batch(batches[0])
-    compile_dt = time.perf_counter() - t0
-    logger.info("jax warmup/compile: %.1fs", compile_dt)
+    # phase 1: all host-side prep + ALL floor measurements (fork-safe: no
+    # jax yet); phase 2: jax timings per config
+    preps = [prepare(c, cache_dir) for c in configs]
+    floors = [measure_floor(c, p, n_procs) for c, p in zip(configs, preps)]
+    jaxrs = [measure_jax(c, p) for c, p in zip(configs, preps)]
 
-    # steady-state pipelined throughput: reps x batches enqueued as one
-    # stream, one sync at the end (matches a production-size formula DB where
-    # hundreds of batches flow through the one executable)
-    stream = batches * args.reps
-    n_scored = table.n_ions * args.reps
-    t0 = time.perf_counter()
-    backend.score_batches(stream)
-    jax_dt = time.perf_counter() - t0
-    jax_rate = n_scored / jax_dt
-    logger.info("jax_tpu: %d ions in %.2fs -> %.1f ions/s", n_scored, jax_dt, jax_rate)
-
-    print(json.dumps({
+    out = {
         "metric": "ions_scored_per_sec_per_chip",
-        "value": round(jax_rate, 2),
         "unit": "ions/s",
-        "vs_baseline": round(jax_rate / np_rate, 2),
-        "numpy_floor_ions_per_s": round(np_rate, 2),
-        "numpy_floor_n_ions": int(sub.n_ions),
-        "floor_procs": int(n_procs),
-        "numpy_floor_multiproc_ions_per_s": round(mp_rate, 2),
-        "vs_baseline_multiproc": round(jax_rate / mp_rate, 2),
-        "compile_s": round(compile_dt, 2),
-        "n_ions": int(table.n_ions),
-        "n_pixels": int(ds.n_pixels),
-        "pixels_per_s": round(jax_rate * ds.n_pixels, 0),
-        "isocalc_s": round(isocalc_dt, 2),
-    }))
+        **report(preps[0], floors[0], jaxrs[0]),
+    }
+    if len(configs) > 1:
+        out["scale"] = report(preps[1], floors[1], jaxrs[1])
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
