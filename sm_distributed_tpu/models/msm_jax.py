@@ -353,9 +353,13 @@ class JaxBackend:
         return runs[2] <= 0.7 * self._mz_host.size
 
     def _grow_compact_capacity(self, runs) -> None:
+        # clamp at the resident peak count: padded slots still gather and
+        # scatter, so a 64k rounding floor on a tiny dataset would cost
+        # more than the plain path
+        cap = max(1, int(self._px_s.shape[0]))
         rnd = 1 << 16
-        self._n_keep = max(
-            self._n_keep, -(-max(runs[2], 1) // rnd) * rnd)
+        want = min(-(-max(runs[2], 1) // rnd) * rnd, cap)
+        self._n_keep = max(self._n_keep, want)
         self._r_pad = max(
             self._r_pad, -(-max(runs[0].size, 1) // 4096) * 4096)
 

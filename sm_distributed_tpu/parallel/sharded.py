@@ -43,6 +43,8 @@ from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
 )
 from ..ops.imager_jax import (
+    batch_peak_runs,
+    compact_peaks,
     extract_images_flat_banded,
     flat_bound_ranks,
     prepare_flat_sharded_arrays,
@@ -84,13 +86,26 @@ def build_sharded_score_factory(
     n_pix = mesh.shape[PIXELS_AXIS]
 
     def step(px_s, in_s, pos, starts, r_lo_loc, r_hi_loc, inv,
-             theor_ints, n_valid, *, gc_width):
+             theor_ints, n_valid, run_pos, run_delta, n_b,
+             *, gc_width, n_keep):
         # Per-device blocks: px_s/in_s (1, Nmax); pos (1, G_loc); plan
-        # (C, Wc)/(C,)/(W_loc,); theor (B_loc, K); n_valid (B_loc,).
+        # (C, Wc)/(C,)/(W_loc,); theor (B_loc, K); n_valid (B_loc,);
+        # compaction runs (1, R_pad)/(1, R_pad)/(1, 1) per (pixel-shard x
+        # formula-shard) — n_keep == 0 selects the plain path (single
+        # executable per (gc_width, n_keep) pair, mirroring JaxBackend).
         b, k = theor_ints.shape
+        if n_keep:
+            px_loc, in_loc = compact_peaks(
+                px_s[0], in_s[0], run_pos[0], run_delta[0], n_b[0, 0],
+                n_keep=n_keep, n_pixels=p_loc)
+        else:
+            px_loc, in_loc = px_s[0], in_s[0]
         imgs_loc = extract_images_flat_banded(
-            px_s[0], in_s[0], pos[0], starts, r_lo_loc, r_hi_loc, inv,
+            px_loc, in_loc, pos[0], starts, r_lo_loc, r_hi_loc, inv,
             gc_width=gc_width, n_pixels=p_loc)
+        # materialize before the metric consumers (see models/msm_jax.py:
+        # measured 3.4x fusion regression at 65k pixels without it)
+        imgs_loc = jax.lax.optimization_barrier(imgs_loc)
         imgs_loc = imgs_loc.reshape(b, k, -1)            # (B_loc, K, P_loc)
         # The "shuffle": trade pixel slices for full-pixel ion sub-batches.
         # Device j of the pixel group ends with (B_loc/n_pix, K, P_full).
@@ -108,11 +123,11 @@ def build_sharded_score_factory(
         # order, matching the original ion order)
         return jax.lax.all_gather(out_mine, PIXELS_AXIS, axis=0, tiled=True)
 
-    def make(gc_width):
+    def make(gc_width, n_keep=0):
         from functools import partial
 
         sharded = jax.shard_map(
-            partial(step, gc_width=gc_width),
+            partial(step, gc_width=gc_width, n_keep=n_keep),
             mesh=mesh,
             in_specs=(
                 P(PIXELS_AXIS, None),             # px_s (S, Nmax)
@@ -124,6 +139,9 @@ def build_sharded_score_factory(
                 P(FORMULAS_AXIS),                 # inv (F*W_loc,)
                 P(FORMULAS_AXIS, None),           # theor_ints
                 P(FORMULAS_AXIS),                 # n_valid
+                P(PIXELS_AXIS, FORMULAS_AXIS),    # run_pos (S, F*R_pad)
+                P(PIXELS_AXIS, FORMULAS_AXIS),    # run_delta (S, F*R_pad)
+                P(PIXELS_AXIS, FORMULAS_AXIS),    # n_b (S, F)
             ),
             out_specs=P(FORMULAS_AXIS, None),
             # The output IS replicated over "pixels" (tiled all_gather of the
@@ -198,6 +216,12 @@ class ShardedJaxBackend:
         if restrict_table is not None:
             mz_s, px_s, in_s = self._restrict_shards(
                 mz_s, px_s, in_s, restrict_table)
+        from ..ops.quantize import MZ_PAD_Q
+
+        self._compaction = sm_config.parallel.peak_compaction
+        self._max_row = max(1, int((mz_s != MZ_PAD_Q).sum(axis=1).max()))
+        self._n_keep = 0          # sticky compacted capacity (see JaxBackend)
+        self._r_pad = 0           # sticky run-list capacity
         self.int_scale = ds.intensity_quantization(self.ppm)[1]
         flat_sharding = NamedSharding(self.mesh, P(PIXELS_AXIS, None))
         self._mz_shards = mz_s                 # host-side, for bound ranks
@@ -258,10 +282,12 @@ class ShardedJaxBackend:
         nv_p[:n] = table.n_valid
         # Per-formula-shard bound grids: shard f histograms only its windows.
         f = self._n_form_shards
+        n_px = self._mz_shards.shape[0]
         b_loc = b // f
         poss, starts_l, rlo_l, rhi_l, invs, gc = [], [], [], [], [], 0
-        for s in range(f):
-            sl = slice(s * b_loc, (s + 1) * b_loc)
+        runs_sf: list[list] = [[] for _ in range(n_px)]  # [s][f] run plans
+        for fi in range(f):
+            sl = slice(fi * b_loc, (fi + 1) * b_loc)
             grid, rl, rh = window_rank_grid(lo_p[sl], hi_p[sl])
             st, rll, rhl, inv, gcs = window_chunks(rl, rh, _BAND_WINDOWS)
             gc = max(gc, gcs)
@@ -270,24 +296,87 @@ class ShardedJaxBackend:
             rhi_l.append(rhl)
             invs.append(inv)
             # ranks of this formula shard's bounds in EVERY pixel shard's
-            # sorted peaks: (S, G_loc)
-            poss.append(np.stack([
-                flat_bound_ranks(self._mz_shards[px], grid)
-                for px in range(self._mz_shards.shape[0])
-            ]))
+            # sorted peaks: (S, G_loc) — plus, unless disabled, the
+            # per-(pixel-shard, formula-shard) compaction runs
+            pos_rows = []
+            for px in range(n_px):
+                pos_px = flat_bound_ranks(self._mz_shards[px], grid)
+                pos_rows.append(pos_px)
+                if self._compaction != "off":
+                    runs_sf[px].append(batch_peak_runs(
+                        self._mz_shards[px], lo_p[sl], hi_p[sl], pos_px))
+            poss.append(np.stack(pos_rows))
+        runs = runs_sf if self._compaction != "off" else None
         return (np.concatenate(poss, axis=1), np.concatenate(starts_l),
                 np.concatenate(rlo_l), np.concatenate(rhi_l),
-                np.concatenate(invs), ints_p, nv_p, gc)
+                np.concatenate(invs), ints_p, nv_p, gc, runs)
+
+    def _use_compaction(self, runs) -> bool:
+        """Per-batch mesh-wide decision (all devices must run one program):
+        compact when the busiest (pixel-shard, formula-shard) cell keeps a
+        minority of the busiest shard's peaks — the same 0.7 rule as the
+        single-device backend, on per-device work."""
+        if runs is None or self._compaction == "off":
+            return False
+        if self._compaction == "on":
+            return True
+        max_keep = max(r[2] for row in runs for r in row)
+        return max_keep <= 0.7 * self._max_row
+
+    def _grow_compact_capacity(self, runs) -> None:
+        # capacity clamps at the per-shard resident row length: padding
+        # slots still gather/scatter, so a 64k rounding floor on a 10k-peak
+        # shard would cost MORE than the plain path
+        cap = max(1, int(self._px_s.shape[1]))
+        rnd = 1 << 16
+        max_keep = max((r[2] for row in runs for r in row), default=1)
+        max_runs = max((r[0].size for row in runs for r in row), default=1)
+        want = min(-(-max(max_keep, 1) // rnd) * rnd, cap)
+        self._n_keep = max(self._n_keep, want)
+        self._r_pad = max(self._r_pad, -(-max(max_runs, 1) // 4096) * 4096)
+
+    def _pack_runs(self, runs):
+        """(run_pos (S, F*R_pad), run_delta (S, F*R_pad), n_b (S, F),
+        pos_b (S, F*G_loc)) padded to the sticky capacities."""
+        n_px, f = len(runs), len(runs[0])
+        rp = np.full((n_px, f * self._r_pad), self._n_keep, np.int32)
+        rd = np.zeros((n_px, f * self._r_pad), np.int32)
+        nb = np.zeros((n_px, f), np.int32)
+        posb = []
+        for s in range(n_px):
+            row_pos = []
+            for fi in range(f):
+                run_pos, run_delta, n_b, pos_b = runs[s][fi]
+                o = fi * self._r_pad
+                rp[s, o : o + run_pos.size] = run_pos
+                rd[s, o : o + run_delta.size] = run_delta
+                nb[s, fi] = n_b
+                row_pos.append(pos_b)
+            posb.append(np.concatenate(row_pos))
+        return rp, rd, nb, np.stack(posb)
 
     def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
         """Async: enqueue one padded sharded batch, return (device_out, n)."""
         if flat_plan is None:
             flat_plan = self._flat_plan(table)
-        pos, starts, rlo, rhi, inv, ints_p, nv_p, gc = flat_plan
+        pos, starts, rlo, rhi, inv, ints_p, nv_p, gc, runs = flat_plan
         self._gc_width = max(self._gc_width, gc)
         gc = self._gc_width
-        if gc not in self._fns:
-            self._fns[gc] = self._make_fn(gc)
+        n_px = self._mz_shards.shape[0]
+        f = self._n_form_shards
+        if self._use_compaction(runs):
+            self._grow_compact_capacity(runs)
+            n_keep = self._n_keep
+            rp, rd, nb, posb = self._pack_runs(runs)
+            pos = posb                 # kept-space bound ranks
+        else:
+            n_keep = 0
+            rp = np.zeros((n_px, f), np.int32)   # unused dummies, (1,1) blocks
+            rd = np.zeros((n_px, f), np.int32)
+            nb = np.zeros((n_px, f), np.int32)
+        key = (gc, n_keep)
+        if key not in self._fns:
+            self._fns[key] = self._make_fn(gc, n_keep)
         pos_d = jax.device_put(pos, self._pos_sharding)
         starts_d = jax.device_put(starts, self._nv_sharding)
         rlo_d = jax.device_put(rlo, self._form_sharding)
@@ -295,8 +384,12 @@ class ShardedJaxBackend:
         inv_d = jax.device_put(inv, self._nv_sharding)
         ints_d = jax.device_put(ints_p, self._form_sharding)
         nv_d = jax.device_put(nv_p, self._nv_sharding)
-        out = self._fns[gc](self._px_s, self._in_s, pos_d, starts_d,
-                            rlo_d, rhi_d, inv_d, ints_d, nv_d)
+        rp_d = jax.device_put(rp, self._pos_sharding)
+        rd_d = jax.device_put(rd, self._pos_sharding)
+        nb_d = jax.device_put(nb, self._pos_sharding)
+        out = self._fns[key](self._px_s, self._in_s, pos_d, starts_d,
+                             rlo_d, rhi_d, inv_d, ints_d, nv_d,
+                             rp_d, rd_d, nb_d)
         return out, table.n_ions
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
@@ -315,25 +408,41 @@ class ShardedJaxBackend:
 
         tables = list(tables)
         plans = [self._flat_plan(t) for t in tables]
-        for plan in plans:
-            self._gc_width = max(self._gc_width, plan[7])
+        self._grow_static_shapes(plans)
         return fetch_scored_batches(
             [self._dispatch(t, plan) for t, plan in zip(tables, plans)])
 
+    def _grow_static_shapes(self, plans) -> None:
+        for plan in plans:
+            self._gc_width = max(self._gc_width, plan[7])
+            if self._use_compaction(plan[8]):
+                self._grow_compact_capacity(plan[8])
+
     def presize(self, tables) -> None:
-        """Grow the sticky band width to cover ``tables`` without scoring
+        """Grow the sticky static shapes to cover ``tables`` without scoring
         (see JaxBackend.presize — avoids mid-search recompiles when the
         orchestrator scores in checkpoint groups)."""
-        for t in tables:
-            self._gc_width = max(self._gc_width, self._flat_plan(t)[7])
+        self._grow_static_shapes([self._flat_plan(t) for t in tables])
 
     def warmup(self, tables) -> None:
-        """Compile the (single) sharded executable: presize + score one
-        batch (mirrors JaxBackend.warmup for bench/daemon callers)."""
+        """Compile every executable variant the stream will use: one
+        representative batch per (plain | compaction) kind, pre-sized
+        (mirrors JaxBackend.warmup for bench/daemon callers)."""
+        from ..models.msm_jax import to_numpy_global
+
         tables = list(tables)
-        self.presize(tables)
-        if tables:
-            self.score_batch(tables[0])
+        plans = [self._flat_plan(t) for t in tables]
+        self._grow_static_shapes(plans)
+        seen: set[bool] = set()
+        for t, plan in zip(tables, plans):
+            kind = self._use_compaction(plan[8])
+            if kind not in seen:
+                seen.add(kind)
+                # reuse the precomputed plan — _flat_plan is the expensive
+                # host pass (per-cell searchsorted over the shard peaks)
+                to_numpy_global(self._dispatch(t, plan)[0])
+            if len(seen) == 2:
+                break
 
 
 def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig,

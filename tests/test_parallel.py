@@ -195,3 +195,35 @@ def test_sharded_hbm_guard_and_mz_chunk_rejection(fixture_ds):
     with pytest.raises(ValueError, match="mz_chunk"):
         ShardedJaxBackend(ds, ds_config, sm_chunk,
                           mesh=make_mesh(sm_chunk.parallel))
+
+
+@pytest.mark.parametrize("pix,form", [(4, 2), (2, 4)])
+def test_sharded_peak_compaction_bit_exact(fixture_ds, pix, form):
+    """Mesh-path per-batch peak compaction (each device gathers only its
+    shard's in-window peaks) must leave every scored bit unchanged —
+    forced on vs off, incl. with the search-union restriction active."""
+    from sm_distributed_tpu.parallel.mesh import make_mesh
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
+
+    ds, truth = fixture_ds
+    table = _table(truth)
+
+    def mk(mode, restrict=None):
+        sm = SMConfig.from_dict(
+            {"backend": "jax_tpu",
+             "parallel": {"formula_batch": 32, "pixels_axis": pix,
+                          "formulas_axis": form, "peak_compaction": mode}})
+        return ShardedJaxBackend(ds, DSConfig.from_dict(
+            {"isotope_generation": {"adducts": ["+H"]}}), sm,
+            mesh=make_mesh(sm.parallel), restrict_table=restrict)
+
+    plain = mk("off").score_batch(table)
+    np.testing.assert_array_equal(mk("on").score_batch(table), plain)
+    np.testing.assert_array_equal(
+        mk("on", restrict=table).score_batch(table), plain)
+    # streams mixing both variants (auto) still agree
+    half = _table(truth, n=8)
+    b_auto = mk("auto")
+    outs = b_auto.score_batches([table, half])
+    np.testing.assert_array_equal(outs[0], plain)
+    np.testing.assert_array_equal(outs[1], mk("off").score_batch(half))
