@@ -11,8 +11,12 @@ Run from the repo root (no external data or services needed):
 from __future__ import annotations
 
 import argparse
+import sys
 import tempfile
 from pathlib import Path
+
+# runnable as `python examples/annotate_demo.py` without installation
+sys.path.insert(0, str(Path(__file__).parent.parent))
 
 
 def main() -> int:

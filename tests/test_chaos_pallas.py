@@ -123,3 +123,20 @@ def test_wide_image_lean_kernel_matches_scipy(rng):
                                       interpret=True))
     for i in range(2):
         assert got[i] == _oracle_count_sum(img[i].reshape(r, c), 3)
+
+
+def test_work_span_result_invariant(rng):
+    """The span-2 certificate carries exactness: any work-sweep span must
+    give identical counts (spans only change how fast the flood converges,
+    never where it converges)."""
+    r, c = 16, 33
+    imgs = np.where(rng.random((4, r * c)) < 0.5,
+                    rng.random((4, r * c)), 0).astype(np.float32)
+    base = np.asarray(chaos_count_sums(imgs, nrows=r, ncols=c, nlevels=5,
+                                       interpret=True, work_span=0))
+    for span in (2, 3, 8, 64):
+        got = np.asarray(chaos_count_sums(imgs, nrows=r, ncols=c, nlevels=5,
+                                          interpret=True, work_span=span))
+        np.testing.assert_array_equal(got, base, err_msg=f"span={span}")
+    for i in range(4):
+        assert base[i] == _oracle_count_sum(imgs[i].reshape(r, c), 5)
