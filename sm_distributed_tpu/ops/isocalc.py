@@ -45,10 +45,11 @@ def _merge_states(masses: np.ndarray, abunds: np.ndarray) -> tuple[np.ndarray, n
     # group indices: new group wherever the gap exceeds the merge width
     group = np.concatenate([[0], np.cumsum(np.diff(masses) > _MERGE_DA)])
     n = group[-1] + 1
-    ab = np.zeros(n)
-    np.add.at(ab, group, abunds)
-    wm = np.zeros(n)
-    np.add.at(wm, group, masses * abunds)
+    # bincount == add.at here (same left-to-right accumulation order, so
+    # identical f64 bits) at a fraction of the cost — add.at's unbuffered
+    # ufunc loop was the fine-structure hot spot
+    ab = np.bincount(group, weights=abunds, minlength=n)
+    wm = np.bincount(group, weights=masses * abunds, minlength=n)
     return wm / ab, ab
 
 
@@ -140,8 +141,9 @@ def centroids(
     x = grid[idx] - mzs_fs[:, None]
     contrib = np.where(
         in_range, abunds_fs[:, None] * np.exp(-0.5 * (x / isocalc_sigma) ** 2), 0.0)
-    profile = np.zeros(npts)
-    np.add.at(profile, idx, contrib)
+    # bincount over the raveled (state, window) grid accumulates in the same
+    # row-major order as add.at — identical f64 bits, much faster
+    profile = np.bincount(idx.ravel(), weights=contrib.ravel(), minlength=npts)
 
     # local maxima
     mids = (profile[1:-1] >= profile[:-2]) & (profile[1:-1] > profile[2:])

@@ -28,16 +28,25 @@ def golden():
     return json.loads(GOLDEN_PATH.read_text())
 
 
-@pytest.fixture(
-    scope="module",
-    params=[("numpy_ref", False), ("jax_tpu", False),
-            ("numpy_ref", True), ("jax_tpu", True)],
-    ids=["numpy", "jax", "numpy-preproc", "jax-preproc"],
-)
+_VARIANTS = {
+    # id -> (backend, preprocessing, adducts, golden section key or None=root)
+    "numpy": ("numpy_ref", False, ("+H",), None),
+    "jax": ("jax_tpu", False, ("+H",), None),
+    "numpy-preproc": ("numpy_ref", True, ("+H",), "preprocessing"),
+    "jax-preproc": ("jax_tpu", True, ("+H",), "preprocessing"),
+    "numpy-multiadduct": ("numpy_ref", False, ("+H", "+Na", "+K"),
+                          "multi_adduct"),
+    "jax-multiadduct": ("jax_tpu", False, ("+H", "+Na", "+K"),
+                        "multi_adduct"),
+}
+
+
+@pytest.fixture(scope="module", params=list(_VARIANTS), ids=list(_VARIANTS))
 def _bundle_and_section(request, tmp_path_factory):
-    backend, preproc = request.param
-    td = tmp_path_factory.mktemp(f"golden_{backend}_{int(preproc)}")
-    return build_bundle(td, backend=backend, preprocessing=preproc), preproc
+    backend, preproc, adducts, key = _VARIANTS[request.param]
+    td = tmp_path_factory.mktemp(f"golden_{request.param}")
+    return build_bundle(td, backend=backend, preprocessing=preproc,
+                        adducts=adducts), key
 
 
 @pytest.fixture(scope="module")
@@ -47,7 +56,8 @@ def bundle(_bundle_and_section):
 
 @pytest.fixture(scope="module")
 def section(_bundle_and_section, golden):
-    return golden["preprocessing"] if _bundle_and_section[1] else golden
+    key = _bundle_and_section[1]
+    return golden[key] if key else golden
 
 
 def test_metrics_match_golden(section, bundle):
