@@ -128,14 +128,17 @@ def measure_floor(cfg: BenchConfig, prep: dict, n_procs: int) -> dict:
 
     np_backend, sub = prep["np_backend"], prep["sub"]
     np_backend.score_batch(_slice_table(prep["table"], 0, 2))  # warm caches
+    # median of 5: the shared-host core's floor swings ~±25% run to run
+    # (measured 77-106 ions/s on the scale case across round 3) and
+    # vs_baseline should ride that noise as little as possible
     np_dts = []
-    for _ in range(3):
+    for _ in range(5):
         t0 = time.perf_counter()
         np_backend.score_batch(sub)
         np_dts.append(time.perf_counter() - t0)
-    np_dt = sorted(np_dts)[1]
+    np_dt = sorted(np_dts)[2]
     np_rate = sub.n_ions / np_dt
-    logger.info("[%s] numpy_ref: %d ions in %.2fs (median of 3) -> %.1f ions/s",
+    logger.info("[%s] numpy_ref: %d ions in %.2fs (median of 5) -> %.1f ions/s",
                 cfg.name, sub.n_ions, np_dt, np_rate)
 
     if n_procs > 1:
@@ -231,7 +234,7 @@ def main() -> None:
     ap.add_argument("--n-formulas", type=int, default=250,
                     help="fixture formulas (x21 adducts -> ion count)")
     ap.add_argument("--reps", type=int, default=3)
-    ap.add_argument("--baseline-ions", type=int, default=210,
+    ap.add_argument("--baseline-ions", type=int, default=300,
                     help="ions timed on numpy_ref (per-ion rate extrapolates)")
     ap.add_argument("--floor-procs", type=int, default=0,
                     help="processes for the multi-core numpy floor "

@@ -304,15 +304,28 @@ class JaxBackend:
             # sticky static shapes: grow to the max seen so one executable
             # serves (almost) all batches instead of recompiling per batch
             self._gc_width = 0
+            self._gc_tail = 0         # band width of the small-batch variant
             self._n_keep = 0          # compacted peak capacity
             self._r_pad = 0           # compaction run-list capacity
             self._compaction = sm_config.parallel.peak_compaction
 
-    def _padded_windows(self, table: IsotopePatternTable):
+    # static batch size for SMALL tables (the stream's tail): a 212-ion
+    # final slice padded to formula_batch=2048 pays the full batch's
+    # histogram zero-fill + chaos + metrics cost — a second executable at
+    # this size cuts that to ~1/8th for one extra (cached) compile
+    _TAIL_BATCH = 256
+
+    def _batch_for(self, n: int) -> int:
+        # cube path and small formula_batch configs keep one executable
+        if self.mz_chunk or self.batch <= self._TAIL_BATCH:
+            return self.batch
+        return self._TAIL_BATCH if n <= self._TAIL_BATCH else self.batch
+
+    def _padded_windows(self, table: IsotopePatternTable, b: int | None = None):
         """Pad one batch's quantized windows to the static batch size
         (padded ions: bounds (0, 0), n_valid=0 -> all metrics 0) and rank
         the bounds: (grid, r_lo, r_hi, ints_p, nv_p)."""
-        n, b = table.n_ions, self.batch
+        n, b = table.n_ions, b or self.batch
         if n > b:
             raise ValueError(f"batch of {n} ions exceeds formula_batch={b}")
         k = table.max_peaks
@@ -333,14 +346,15 @@ class JaxBackend:
         per-batch peak-compaction runs.  Computed once per table
         (score_batches builds the plans up front to pre-size the static
         shapes, then reuses them)."""
-        grid, r_lo, r_hi, ints_p, nv_p = self._padded_windows(table)
+        b_eff = self._batch_for(table.n_ions)
+        grid, r_lo, r_hi, ints_p, nv_p = self._padded_windows(table, b_eff)
         chunks = window_chunks(r_lo, r_hi, _BAND_WINDOWS)
         pos = flat_bound_ranks(self._mz_host, grid)
         runs = None
         if self._compaction != "off":
             lo_q, hi_q = quantize_window(table.mzs, self.ppm)
             runs = batch_peak_runs(self._mz_host, lo_q, hi_q, pos)
-        return (grid, r_lo, r_hi, ints_p, nv_p, chunks, pos, runs)
+        return (grid, r_lo, r_hi, ints_p, nv_p, chunks, pos, runs, b_eff)
 
     def _use_compaction(self, runs) -> bool:
         """Compaction wins when a batch touches a minority of the resident
@@ -379,9 +393,17 @@ class JaxBackend:
         else:
             if flat_plan is None:
                 flat_plan = self._flat_plan(table)
-            _grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs = flat_plan
+            (_grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs,
+             b_eff) = flat_plan
             starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
-            self._gc_width = max(self._gc_width, gc_width)
+            # the tail executable keeps its own sticky band width: sharing
+            # the full-size band would blow the small batch's matmul cost
+            if b_eff == self.batch:
+                self._gc_width = max(self._gc_width, gc_width)
+                gc_eff = self._gc_width
+            else:
+                self._gc_tail = max(self._gc_tail, gc_width)
+                gc_eff = self._gc_tail
             if self._use_compaction(runs):
                 run_pos, run_delta, n_b, pos_b = runs
                 self._grow_compact_capacity(runs)
@@ -394,12 +416,12 @@ class JaxBackend:
                     starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
                 out = self._fn_c(self._px_s, self._in_s, *args,
                                  n_keep=self._n_keep,
-                                 gc_width=self._gc_width, b=b, k=k)
+                                 gc_width=gc_eff, b=b_eff, k=k)
             else:
                 args = [jax.device_put(a) for a in (
                     pos, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
                 out = self._fn(self._px_s, self._in_s, *args,
-                               gc_width=self._gc_width, b=b, k=k)
+                               gc_width=gc_eff, b=b_eff, k=k)
         return out, n
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
@@ -457,10 +479,15 @@ class JaxBackend:
         if self.mz_chunk:
             return
         for t in tables:
-            plan = self._flat_plan(t)
+            self._grow_from_plan(self._flat_plan(t))
+
+    def _grow_from_plan(self, plan) -> None:
+        if plan[8] == self.batch:
             self._gc_width = max(self._gc_width, plan[5][4])
-            if self._use_compaction(plan[7]):
-                self._grow_compact_capacity(plan[7])
+        else:
+            self._gc_tail = max(self._gc_tail, plan[5][4])
+        if self._use_compaction(plan[7]):
+            self._grow_compact_capacity(plan[7])
 
     def warmup(self, tables) -> None:
         """Compile every executable ``tables`` will use, scoring ONE
@@ -472,16 +499,16 @@ class JaxBackend:
             if tables:
                 self.score_batch(tables[0])
             return
-        self.presize(tables)
+        plans = [self._flat_plan(t) for t in tables]
+        for plan in plans:
+            self._grow_from_plan(plan)
         reps, seen = [], set()
-        for t in tables:
-            kind = self._use_compaction(self._flat_plan(t)[7])
+        for t, plan in zip(tables, plans):
+            kind = (self._use_compaction(plan[7]), plan[8])
             if kind not in seen:
                 seen.add(kind)
-                reps.append(t)
-            if len(seen) == 2:
-                break
-        self.score_batches(reps)
+                reps.append((t, plan))
+        fetch_scored_batches([self._dispatch(t, plan) for t, plan in reps])
 
     def score_batches(self, tables) -> list[np.ndarray]:
         """Pipelined scoring: enqueue every batch before syncing any result
@@ -496,8 +523,6 @@ class JaxBackend:
         # tunneled TPU), and each plan is reused by its dispatch
         plans = [self._flat_plan(t) for t in tables]
         for plan in plans:
-            self._gc_width = max(self._gc_width, plan[5][4])
-            if self._use_compaction(plan[7]):
-                self._grow_compact_capacity(plan[7])
+            self._grow_from_plan(plan)
         return fetch_scored_batches(
             [self._dispatch(t, plan) for t, plan in zip(tables, plans)])

@@ -400,3 +400,52 @@ def test_batch_peak_runs_plan_exact():
         # re-based ranks count kept peaks strictly below each bound
         want = np.searchsorted(kept, grid, side="left")
         np.testing.assert_array_equal(pos_b, want)
+
+
+def test_tail_batch_executable_matches(fixture_ds):
+    """A stream's small final slice runs through the 256-wide tail
+    executable (full-size padding would pay ~8x its cost); results must be
+    identical to full-size padding and to the numpy oracle."""
+    from sm_distributed_tpu.models.msm_basic import NumpyBackend, _slice_table
+    from sm_distributed_tpu.models.msm_jax import JaxBackend
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    ds, truth = fixture_ds
+    calc = IsocalcWrapper(IsotopeGenerationConfig(adducts=("+H", "+Na")))
+    table = calc.pattern_table(
+        [(sf, ad) for sf in truth.formulas[:20] for ad in ("+H", "+Na")])
+    assert table.n_ions > 8
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+    sm = SMConfig.from_dict(
+        {"backend": "jax_tpu", "parallel": {"formula_batch": 300}})
+    backend = JaxBackend(ds, ds_config, sm)
+    # default threshold routing
+    assert backend._batch_for(8) == 256
+    assert backend._batch_for(2048) == 300
+    # a MIXED-size stream through both executables: shrink the tail
+    # threshold so the head (32 ions) takes the full-size (b=300) variant
+    # while the tail (8 ions) takes the small one — this exercises the
+    # b_eff plumbing on both, in one warmed backend
+    backend._TAIL_BATCH = 8
+    head = _slice_table(table, 0, table.n_ions - 8)
+    tail = _slice_table(table, table.n_ions - 8, table.n_ions)
+    assert backend._batch_for(head.n_ions) == 300
+    assert backend._batch_for(tail.n_ions) == 8
+    outs = backend.score_batches([head, tail])
+    np_b = NumpyBackend(ds, ds_config)
+    np.testing.assert_array_equal(outs[0][:, 0], np_b.score_batch(head)[:, 0])
+    np.testing.assert_array_equal(outs[1][:, 0], np_b.score_batch(tail)[:, 0])
+    np.testing.assert_allclose(outs[0], np_b.score_batch(head), atol=1e-6)
+    np.testing.assert_allclose(outs[1], np_b.score_batch(tail), atol=1e-6)
+    # single-batch entry point takes the tail path too
+    np.testing.assert_array_equal(backend.score_batch(tail), outs[1])
+    # padding-size invariance: the same tail through a small-batch config
+    # (single full-size executable) gives identical metric bits
+    sm_small = SMConfig.from_dict(
+        {"backend": "jax_tpu", "parallel": {"formula_batch": 40}})
+    b_small = JaxBackend(ds, ds_config, sm_small)
+    np.testing.assert_array_equal(
+        b_small.score_batch(tail)[:, 0], outs[1][:, 0])
