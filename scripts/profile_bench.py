@@ -103,7 +103,7 @@ def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
 
     # the backend's own batch plan — identical host prep to score_batch
     plan = backend._flat_plan(sub)
-    grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs = plan
+    grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs, b_eff = plan
     starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
     logger.info("batch=%d ions, k=%d, grid=%d bins, %d peaks resident, "
                 "gc_width=%d, compact=%s (keep %s)",
@@ -129,7 +129,7 @@ def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
         reps=reps)
     # keep the (W, P) image block ON DEVICE — a host round-trip of this
     # multi-GB array takes minutes through the tunnel
-    imgs = imgs_flat.reshape(b, k, -1)
+    imgs = imgs_flat.reshape(b_eff, k, -1)
     valid_d = jax.device_put(np.arange(k)[None, :] < nv_p[:, None])
     ints_d = jax.device_put(ints_p)
 
