@@ -180,10 +180,10 @@ class SearchJob:
         """Persist ion images for annotations at FDR <= 0.5 (the reference
         stores images for scored target ions — ``store_sf_iso_images`` [U]).
 
-        On the jax path the images come off the DEVICE cube (bit-identical to
-        the numpy extraction via the shared integer grids) instead of being
-        re-extracted on CPU (VERDICT r1 item 9); backends without the device
-        exporter (numpy_ref, sharded) use the numpy extractor.
+        On the jax paths — single-device AND mesh-sharded — the images come
+        off the DEVICE arrays (bit-identical to the numpy extraction via the
+        shared integer grids) instead of being re-extracted on CPU (VERDICT
+        r1 item 9); numpy_ref uses the numpy extractor.
         """
         import numpy as np
 

@@ -286,30 +286,39 @@ class ShardedJaxBackend:
         b_loc = b // f
         poss, starts_l, rlo_l, rhi_l, invs, gc = [], [], [], [], [], 0
         runs_sf: list[list] = [[] for _ in range(n_px)]  # [s][f] run plans
-        for fi in range(f):
-            sl = slice(fi * b_loc, (fi + 1) * b_loc)
-            grid, rl, rh = window_rank_grid(lo_p[sl], hi_p[sl])
+        for fi, (sl, grid, rl, rh, pos_rows) in enumerate(
+                self._shard_grids(lo_p, hi_p)):
             st, rll, rhl, inv, gcs = window_chunks(rl, rh, _BAND_WINDOWS)
             gc = max(gc, gcs)
             starts_l.append(st)
             rlo_l.append(rll)
             rhi_l.append(rhl)
             invs.append(inv)
-            # ranks of this formula shard's bounds in EVERY pixel shard's
-            # sorted peaks: (S, G_loc) — plus, unless disabled, the
-            # per-(pixel-shard, formula-shard) compaction runs
-            pos_rows = []
-            for px in range(n_px):
-                pos_px = flat_bound_ranks(self._mz_shards[px], grid)
-                pos_rows.append(pos_px)
-                if self._compaction != "off":
+            if self._compaction != "off":
+                for px in range(n_px):
                     runs_sf[px].append(batch_peak_runs(
-                        self._mz_shards[px], lo_p[sl], hi_p[sl], pos_px))
+                        self._mz_shards[px], lo_p[sl], hi_p[sl],
+                        pos_rows[px]))
             poss.append(np.stack(pos_rows))
         runs = runs_sf if self._compaction != "off" else None
         return (np.concatenate(poss, axis=1), np.concatenate(starts_l),
                 np.concatenate(rlo_l), np.concatenate(rhi_l),
                 np.concatenate(invs), ints_p, nv_p, gc, runs)
+
+    def _shard_grids(self, lo_p: np.ndarray, hi_p: np.ndarray):
+        """Per formula shard: (row slice, bound grid, r_lo, r_hi, and each
+        pixel shard's bound ranks) — the shared host prep of the score and
+        image-export paths (they must stay in lockstep or the bit-identical
+        contract breaks)."""
+        f = self._n_form_shards
+        n_px = self._mz_shards.shape[0]
+        b_loc = lo_p.shape[0] // f
+        for fi in range(f):
+            sl = slice(fi * b_loc, (fi + 1) * b_loc)
+            grid, rl, rh = window_rank_grid(lo_p[sl], hi_p[sl])
+            pos_rows = [flat_bound_ranks(self._mz_shards[px], grid)
+                        for px in range(n_px)]
+            yield sl, grid, rl, rh, pos_rows
 
     def _use_compaction(self, runs) -> bool:
         """Per-batch mesh-wide decision (all devices must run one program):
@@ -397,6 +406,67 @@ class ShardedJaxBackend:
 
         out, n = self._dispatch(table)
         return to_numpy_global(out)[:n].astype(np.float64)
+
+    def extract_ion_images(self, table: IsotopePatternTable) -> np.ndarray:
+        """(n_ions, K, n_pix) de-quantized ion images off the DEVICE shards —
+        the mesh-path analog of JaxBackend.extract_ion_images, so annotated
+        image export needs no CPU re-extraction on multi-chip runs either.
+
+        Collective-free: each device extracts its (formula-shard window
+        block x pixel-shard slice); the output is sharded over BOTH mesh
+        axes and assembled on host (to_numpy_global).  Bit-identical to the
+        numpy extractor via the shared integer grids."""
+        from ..models.msm_jax import to_numpy_global
+        from ..ops.imager_jax import extract_images_flat
+
+        n, b = table.n_ions, self.batch
+        if n > b:
+            from ..models.msm_basic import _slice_table
+
+            out = [self.extract_ion_images(_slice_table(table, s, min(s + b, n)))
+                   for s in range(0, n, b)]
+            return np.concatenate(out)
+        k = table.max_peaks
+        lo_q, hi_q = quantize_window(table.mzs, self.ppm)
+        lo_p = np.zeros((b, k), dtype=np.int32)
+        hi_p = np.zeros((b, k), dtype=np.int32)
+        lo_p[:n], hi_p[:n] = lo_q, hi_q
+        rlo_l, rhi_l, poss = [], [], []
+        for _sl, _grid, rl, rh, pos_rows in self._shard_grids(lo_p, hi_p):
+            rlo_l.append(rl)
+            rhi_l.append(rh)
+            poss.append(np.stack(pos_rows))
+        p_loc = self._p_loc
+
+        def step(px_s, in_s, pos, rlo, rhi):
+            return extract_images_flat(
+                px_s[0], in_s[0], pos[0], rlo, rhi, n_pixels=p_loc)
+
+        if not hasattr(self, "_extract_fn"):
+            self._extract_fn = jax.jit(jax.shard_map(
+                step,
+                mesh=self.mesh,
+                in_specs=(
+                    P(PIXELS_AXIS, None),             # px_s (S, Nmax)
+                    P(PIXELS_AXIS, None),             # in_s (S, Nmax)
+                    P(PIXELS_AXIS, FORMULAS_AXIS),    # pos (S, F*G_loc)
+                    P(FORMULAS_AXIS),                 # r_lo (F*W_loc,)
+                    P(FORMULAS_AXIS),                 # r_hi (F*W_loc,)
+                ),
+                out_specs=P(FORMULAS_AXIS, PIXELS_AXIS),
+                check_vma=False,
+            ))
+        out = self._extract_fn(
+            self._px_s, self._in_s,
+            jax.device_put(np.concatenate(poss, axis=1), self._pos_sharding),
+            jax.device_put(np.concatenate(rlo_l), self._nv_sharding),
+            jax.device_put(np.concatenate(rhi_l), self._nv_sharding))
+        imgs = np.array(
+            to_numpy_global(out)).reshape(b, k, -1)[:n, :, : self.ds.n_pixels]
+        imgs /= np.float32(self.int_scale)   # exact power-of-two division
+        valid = np.arange(k)[None, :] < table.n_valid[:, None]
+        imgs[~valid] = 0.0
+        return imgs
 
     def score_batches(self, tables) -> list[np.ndarray]:
         """Pipelined like the single-device backend: every batch enqueued

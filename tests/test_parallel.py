@@ -227,3 +227,26 @@ def test_sharded_peak_compaction_bit_exact(fixture_ds, pix, form):
     outs = b_auto.score_batches([table, half])
     np.testing.assert_array_equal(outs[0], plain)
     np.testing.assert_array_equal(outs[1], mk("off").score_batch(half))
+
+
+def test_sharded_extract_ion_images_matches_numpy(fixture_ds):
+    """Mesh-path device image export must equal the numpy extractor bit for
+    bit (shared integer grids) — annotated-image export on multi-chip runs
+    no longer re-extracts on CPU."""
+    from sm_distributed_tpu.ops.imager_np import SortedPeakView, extract_ion_images
+    from sm_distributed_tpu.parallel.mesh import make_mesh
+    from sm_distributed_tpu.parallel.sharded import ShardedJaxBackend
+
+    ds, truth = fixture_ds
+    table = _table(truth, n=10)
+    dc = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]},
+                             "image_generation": {"ppm": 3.0}})
+    sm = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "parallel": {"formula_batch": 8, "pixels_axis": 4,
+                      "formulas_axis": 2}})
+    backend = ShardedJaxBackend(ds, dc, sm, mesh=make_mesh(sm.parallel))
+    got = backend.extract_ion_images(table)     # n=10 > batch=8: batches too
+    view = SortedPeakView.prepare(ds, 3.0)
+    want = extract_ion_images(view, table, 3.0)
+    np.testing.assert_array_equal(got, np.asarray(want))
