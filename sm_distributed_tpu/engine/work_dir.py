@@ -28,6 +28,17 @@ from pathlib import Path
 from ..utils.logger import logger
 
 
+def sibling_ibd_names(filename: str) -> tuple[str, ...]:
+    """Candidate .ibd sibling names for an imzML file (either extension
+    case style), else empty — the ONE pairing rule both fetchers share, so
+    local and S3 staging can't silently disagree on which inputs bring
+    their binary sibling along."""
+    if not filename.lower().endswith(".imzml"):
+        return ()
+    base = filename[: filename.rfind(".")]
+    return (base + ".ibd", base + ".IBD")
+
+
 class LocalFetcher:
     """Filesystem staging: ``src`` is a file (imzML; the sibling .ibd comes
     along) or a directory staged recursively with relative layout preserved
@@ -40,9 +51,11 @@ class LocalFetcher:
             raise FileNotFoundError(f"input path does not exist: {src}")
         if src.is_file():
             out = {src.name: self._sig(src)}
-            ibd = src.with_suffix(".ibd")
-            if ibd.exists():
-                out[ibd.name] = self._sig(ibd)
+            for name in sibling_ibd_names(src.name):
+                ibd = src.with_name(name)
+                if ibd.exists():
+                    out[ibd.name] = self._sig(ibd)
+                    break
             return out
         return {
             str(p.relative_to(src)): self._sig(p)
@@ -67,17 +80,19 @@ class S3Fetcher:
     installed in the offline build image; constructing this fetcher without
     it fails with guidance instead of at first use."""
 
-    def __init__(self):
-        try:
-            import boto3  # noqa: F401 — optional dependency
-        except ImportError as e:
-            raise ImportError(
-                "s3:// staging needs boto3, which is not available in this "
-                "environment; stage the input locally (any filesystem path) "
-                "or install boto3") from e
-        import boto3
-
-        self._s3 = boto3.client("s3")
+    def __init__(self, client=None):
+        """``client``: an injected S3 client (tests exercise the listing and
+        sibling logic with a fake); default constructs a real boto3 client."""
+        if client is None:
+            try:
+                import boto3
+            except ImportError as e:
+                raise ImportError(
+                    "s3:// staging needs boto3, which is not available in "
+                    "this environment; stage the input locally (any "
+                    "filesystem path) or install boto3") from e
+            client = boto3.client("s3")
+        self._s3 = client
         self._keys: dict[str, str] = {}   # rel -> exact object key (per src)
 
     @staticmethod
@@ -86,25 +101,50 @@ class S3Fetcher:
         bucket, _, prefix = rest.partition("/")
         return bucket, prefix
 
+    def _head(self, bucket: str, key: str) -> tuple[list | None, bool]:
+        """``([size, etag] | None, denied)`` — one HEAD request instead of
+        paginating the whole prefix to detect an exact-key match (advisor
+        r3: the scan iterated every object under a broad prefix, twice).
+        404 = absent; 403 = HEAD denied (least-privilege policies return it
+        both for missing s3:GetObject on an existing key and for a missing
+        key without s3:ListBucket) — the caller falls through to the
+        directory listing, and surfaces the denial if nothing else stages
+        so a permissions problem doesn't masquerade as 'no objects'."""
+        try:
+            h = self._s3.head_object(Bucket=bucket, Key=key)
+        except self._s3.exceptions.ClientError as e:
+            meta = e.response.get("ResponseMetadata", {})
+            code = meta.get("HTTPStatusCode")
+            if code in (403, 404):
+                return None, code == 403
+            raise
+        return [h["ContentLength"], h["ETag"].strip('"')], False
+
     def list_files(self, src: str) -> dict[str, list]:
-        """An exact-key URI stages that one object; otherwise the prefix is
-        treated as a directory and listed '/'-terminated, so a sibling
-        prefix (ds1 vs ds10) can never leak into the listing.  Exact object
-        keys are recorded for fetch_file — relpaths are never re-derived."""
+        """An exact-key URI stages that one object (plus its .ibd sibling
+        when it names an .imzML — the reader needs the pair, mirroring
+        LocalFetcher); otherwise the prefix is treated as a directory and
+        listed '/'-terminated, so a sibling prefix (ds1 vs ds10) can never
+        leak into the listing.  Exact object keys are recorded for
+        fetch_file — relpaths are never re-derived."""
         bucket, prefix = self._split(str(src))
-        paginator = self._s3.get_paginator("list_objects_v2")
-        exact: dict | None = None
-        for page in paginator.paginate(Bucket=bucket, Prefix=prefix):
-            for obj in page.get("Contents", []):
-                if obj["Key"] == prefix:
-                    exact = obj
         self._keys = {}
         out: dict[str, list] = {}
+        exact, denied = ((None, False) if not prefix or prefix.endswith("/")
+                         else self._head(bucket, prefix))
         if exact is not None:
             rel = Path(prefix).name
             self._keys[rel] = prefix
-            out[rel] = [exact["Size"], exact["ETag"].strip('"')]
+            out[rel] = exact
+            key_dir = prefix[: -len(rel)]
+            for name in sibling_ibd_names(rel):
+                ibd, _ = self._head(bucket, key_dir + name)
+                if ibd is not None:
+                    self._keys[name] = key_dir + name
+                    out[name] = ibd
+                    break
             return out
+        paginator = self._s3.get_paginator("list_objects_v2")
         dir_prefix = prefix.rstrip("/") + "/" if prefix else ""
         for page in paginator.paginate(Bucket=bucket, Prefix=dir_prefix):
             for obj in page.get("Contents", []):
@@ -116,6 +156,11 @@ class S3Fetcher:
                 self._keys[rel] = obj["Key"]
                 out[rel] = [obj["Size"], obj["ETag"].strip('"')]
         if not out:
+            if denied:
+                raise PermissionError(
+                    f"HEAD on {src} was denied (403) and no objects are "
+                    "listable under it — check s3:GetObject/s3:ListBucket "
+                    "permissions for this key")
             raise FileNotFoundError(f"no objects under {src}")
         return out
 
@@ -172,7 +217,10 @@ class WorkDirManager:
                 staged = json.loads(manifest.read_text())
             except json.JSONDecodeError:
                 staged = {}
-        if staged == listing and dst.exists():
+        # the manifest alone is not proof: a file deleted from dst since the
+        # last staging must fall through to the per-file fetch loop
+        if (staged == listing and dst.exists()
+                and all((dst / rel).is_file() for rel in listing)):
             logger.info("work_dir: input already staged at %s, skipping", dst)
             return dst
         manifest.unlink(missing_ok=True)  # staging no longer current

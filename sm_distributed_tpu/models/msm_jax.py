@@ -165,21 +165,39 @@ def to_numpy_global(arr) -> np.ndarray:
 
     In a real multi-host run the per-batch output spans processes, so plain
     ``np.asarray`` raises on the non-addressable shards.  The output is
-    replicated over the "pixels" mesh axis, so this process's devices
-    normally hold every formula shard — assemble them; if the local shards
-    don't cover the array (unusual mesh/process layout), fall back to an
-    explicit cross-process allgather."""
+    replicated over the "pixels" mesh axis, so each process's devices
+    normally hold every formula shard — assemble them; if any process's
+    local shards don't cover the array (asymmetric device-to-process
+    layout), fall back to an explicit cross-process allgather.  The
+    fallback decision is computed from the GLOBAL sharding metadata, not
+    this process's shards, so every process reaches the same verdict —
+    a per-process decision could leave only some processes entering the
+    collective and deadlock the SPMD program (advisor r3)."""
     if getattr(arr, "is_fully_addressable", True):
         return np.asarray(arr)
-    out = np.zeros(arr.shape, arr.dtype)
-    covered = np.zeros(arr.shape, dtype=bool)
-    for sh in arr.addressable_shards:
-        out[sh.index] = np.asarray(sh.data)
-        covered[sh.index] = True
-    if not covered.all():
+
+    def _key(idx) -> tuple:
+        return tuple((s.start, s.stop, s.step) for s in idx)
+
+    # a process covers the array iff its devices hold every distinct shard
+    # index the full device set holds (the full set covers by definition;
+    # this subset test is exact for disjoint tilings + replication, and for
+    # any exotic overlapping sharding it errs toward the collective)
+    index_map = arr.sharding.devices_indices_map(arr.shape)
+    global_keys = {_key(idx) for idx in index_map.values()}
+    by_proc: dict[int, set] = {}
+    for d, idx in index_map.items():
+        by_proc.setdefault(d.process_index, set()).add(_key(idx))
+    # a process with NO device in this sharding (sub-mesh array) holds no
+    # shards at all — it must take the collective with everyone else
+    if (len(by_proc) != jax.process_count()
+            or any(keys != global_keys for keys in by_proc.values())):
         from jax.experimental import multihost_utils
 
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+    out = np.empty(arr.shape, arr.dtype)
+    for sh in arr.addressable_shards:
+        out[sh.index] = np.asarray(sh.data)
     return out
 
 

@@ -4,6 +4,7 @@ DB-integration + end-to-end test tier (SURVEY.md §4) against the local
 sqlite/parquet/file-queue stand-ins."""
 
 import json
+from pathlib import Path
 
 import numpy as np
 import pandas as pd
@@ -402,6 +403,128 @@ def test_work_dir_s3_scheme_guidance(tmp_path):
         resolve_fetcher("s3://bucket/ds")
     with pytest.raises(ValueError, match="unsupported input scheme"):
         resolve_fetcher("gopher://x")
+
+
+class _FakeS3ClientError(Exception):
+    def __init__(self, status):
+        self.response = {"ResponseMetadata": {"HTTPStatusCode": status}}
+
+
+class _FakeS3Client:
+    """boto3-shaped double: head_object / list_objects_v2 pagination /
+    download_file over an in-memory {key: bytes} store, so S3Fetcher's
+    listing + sibling logic actually executes in this offline image."""
+
+    class exceptions:  # noqa: N801 — boto3 client namespace shape
+        ClientError = _FakeS3ClientError
+
+    def __init__(self, objects):
+        self.objects = dict(objects)
+        self.head_calls, self.list_calls = [], []
+
+    def head_object(self, Bucket, Key):
+        self.head_calls.append(Key)
+        if Key not in self.objects:
+            raise _FakeS3ClientError(404)
+        return {"ContentLength": len(self.objects[Key]),
+                "ETag": f'"etag-{Key}"'}
+
+    def get_paginator(self, op):
+        assert op == "list_objects_v2"
+        client = self
+
+        class _Pager:
+            def paginate(self, Bucket, Prefix):
+                client.list_calls.append(Prefix)
+                contents = [
+                    {"Key": k, "Size": len(v), "ETag": f'"etag-{k}"'}
+                    for k, v in sorted(client.objects.items())
+                    if k.startswith(Prefix)
+                ]
+                yield {"Contents": contents} if contents else {}
+
+        return _Pager()
+
+    def download_file(self, bucket, key, dst):
+        Path(dst).write_bytes(self.objects[key])
+
+
+def test_s3_fetcher_exact_key_stages_ibd_sibling(tmp_path):
+    """Advisor r3 (medium): an exact .imzML key must stage the .ibd pair."""
+    from sm_distributed_tpu.engine.work_dir import S3Fetcher
+
+    client = _FakeS3Client({
+        "data/ds1.imzML": b"imzml-bytes",
+        "data/ds1.ibd": b"ibd-bytes",
+        "data/ds10.imzML": b"other",
+    })
+    f = S3Fetcher(client=client)
+    listing = f.list_files("s3://bucket/data/ds1.imzML")
+    assert sorted(listing) == ["ds1.ibd", "ds1.imzML"]
+    # exact-key detection is HEAD requests, not a prefix scan (advisor r3)
+    assert client.list_calls == []
+    wd = WorkDirManager(tmp_path / "work", "s3ds", fetcher=f)
+    dst = wd.copy_input_data("s3://bucket/data/ds1.imzML")
+    assert (dst / "ds1.imzML").read_bytes() == b"imzml-bytes"
+    assert (dst / "ds1.ibd").read_bytes() == b"ibd-bytes"
+    # a lone imzML (no sibling uploaded) still stages — the reader reports
+    # the missing .ibd later with its own clear error
+    lone = S3Fetcher(client=_FakeS3Client({"d/solo.imzML": b"x"}))
+    assert sorted(lone.list_files("s3://bucket/d/solo.imzML")) == ["solo.imzML"]
+    # uppercase extension pair stages via the shared sibling rule
+    up = S3Fetcher(client=_FakeS3Client({"d/DS1.IMZML": b"i", "d/DS1.IBD": b"b"}))
+    assert sorted(up.list_files("s3://bucket/d/DS1.IMZML")) == [
+        "DS1.IBD", "DS1.IMZML"]
+
+
+def test_s3_fetcher_head_denied_surfaces_permission_error():
+    from sm_distributed_tpu.engine.work_dir import S3Fetcher
+
+    class _DeniedClient(_FakeS3Client):
+        def head_object(self, Bucket, Key):
+            raise _FakeS3ClientError(403)
+
+    # denied HEAD + nothing listable -> a permissions diagnosis, not a
+    # misleading "no objects" (code-review r4)
+    f = S3Fetcher(client=_DeniedClient({}))
+    with pytest.raises(PermissionError, match="403"):
+        f.list_files("s3://bucket/data/ds1.imzML")
+    # denied HEAD but the directory listing works -> staging proceeds
+    ok = S3Fetcher(client=_DeniedClient({"data/ds1/a.imzML": b"A"}))
+    assert sorted(ok.list_files("s3://bucket/data/ds1")) == ["a.imzML"]
+
+
+def test_s3_fetcher_directory_listing_skips_markers_and_siblings(tmp_path):
+    from sm_distributed_tpu.engine.work_dir import S3Fetcher
+
+    client = _FakeS3Client({
+        "data/ds1/": b"",                    # console folder marker
+        "data/ds1/a.imzML": b"A",
+        "data/ds1/sub/b.ibd": b"B",
+        "data/ds10/c.imzML": b"C",           # sibling prefix must not leak
+    })
+    f = S3Fetcher(client=client)
+    listing = f.list_files("s3://bucket/data/ds1")
+    assert sorted(listing) == ["a.imzML", "sub/b.ibd"]
+    # one directory pagination only (advisor r3: was two full listings)
+    assert client.list_calls == ["data/ds1/"]
+    dst = WorkDirManager(tmp_path / "work", "s3dir", fetcher=f).copy_input_data(
+        "s3://bucket/data/ds1")
+    assert (dst / "sub" / "b.ibd").read_bytes() == b"B"
+
+
+def test_work_dir_skip_path_refetches_deleted_files(tmp_path):
+    """Advisor r3: a file deleted from dst after a complete staging must be
+    refetched even though the manifest still matches the listing."""
+    objs = {f"f{i}.bin": (bytes([i]) * 8, "v") for i in range(3)}
+    wd = WorkDirManager(tmp_path / "work", "dsx", fetcher=_FakeRemote(objs))
+    dst = wd.copy_input_data("fake://bucket/ds")
+    (dst / "f1.bin").unlink()
+    healer = _FakeRemote(objs)
+    WorkDirManager(tmp_path / "work", "dsx", fetcher=healer).copy_input_data(
+        "fake://bucket/ds")
+    assert healer.fetch_log == ["f1.bin"]
+    assert (dst / "f1.bin").read_bytes() == objs["f1.bin"][0]
 
 
 def test_daemon_residency_second_job_skips_prepare_and_compile(fixture_path, tmp_path):

@@ -88,6 +88,23 @@ def test_hotspot_clip():
     np.testing.assert_array_equal(hotspot_clip(np.zeros(4)), np.zeros(4))
 
 
+def test_hotspot_percentile_tracks_reference_f64_percentile():
+    # The single-op-f32 cutoff deliberately diverges sub-ulp from the
+    # reference's np.percentile f64 interpolation (that fixed op sequence is
+    # what makes the cutoff bit-identical across backends).  This pins the
+    # divergence as BOUNDED relative to the f64 reference, so silent drift
+    # from the upstream definition stays detectable (advisor r3).
+    from sm_distributed_tpu.ops.metrics_np import hotspot_percentile_f32
+
+    rng = np.random.default_rng(11)
+    for n in (1, 2, 7, 100, 4096):
+        for q in (50.0, 95.0, 99.0):
+            pos = np.sort(rng.gamma(2.0, 1e4, size=n).astype(np.float32))
+            got = hotspot_percentile_f32(pos, q)
+            want = np.percentile(pos.astype(np.float64), q)
+            assert got == pytest.approx(want, rel=1e-6, abs=1e-12)
+
+
 def test_ion_metrics_product():
     nrows = ncols = 8
     yy, xx = np.mgrid[0:nrows, 0:ncols]
