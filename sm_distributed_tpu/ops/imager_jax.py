@@ -422,14 +422,35 @@ def compact_peaks(
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Device-side gather of the kept peak slots: (px_b, in_b), both (n_keep,).
 
-    Slots >= n_b are padding: pixel -> overflow row, intensity -> 0 (they
-    histogram into bin 0 of the overflow row, which is sliced off)."""
+    Slots >= n_b are padding: pixel -> an OUT-OF-BOUNDS row so the
+    histogram scatter DROPS them (default jnp scatter semantics), not the
+    overflow row.  In-bounds padding was a measured pathology: every pad
+    slot's bin is G (all bounds below it), so with a sticky ``n_keep``
+    capacity above the batch's real keep, millions of pads scattered into
+    the ONE cell (overflow_row, G) — and TPU scatter serializes colliding
+    updates (~50 vs ~14 ns/peak; docs/PERF.md mechanism 2).  Dropped
+    updates write nothing, so they can't collide.  Exact either way: pads
+    carry intensity 0 into a bin no window sums.
+
+    The (pixel, intensity) rows are gathered as ONE packed (N, 2) f32
+    gather, not two scalar gathers: a 2-column row gather moves the same
+    slot in one descriptor, measured 483 -> 181 ms for 7.7M slots on v5e
+    (the gather is this function's whole cost; ``indices_are_sorted``
+    hints measured no effect).  Exact while pixel ids < 2**24 (f32
+    integer range) — the scale guard in models/msm_jax.py caps the flat
+    path far below that; the sharded path's ids are shard-local."""
     j = jnp.arange(n_keep, dtype=jnp.int32)
     d = jnp.zeros(n_keep, jnp.int32).at[run_pos].add(run_delta, mode="drop")
     src = jnp.clip(j + jnp.cumsum(d), 0, px_s.shape[0] - 1)
     valid = j < n_b
-    px_b = jnp.where(valid, px_s[src], jnp.int32(n_pixels))
-    in_b = jnp.where(valid, in_s[src], jnp.float32(0.0))
+    if n_pixels < 2**24:
+        pk = jnp.stack([px_s.astype(jnp.float32), in_s], axis=1)
+        got = pk[src]
+        px_b = jnp.where(valid, got[:, 0].astype(jnp.int32), jnp.int32(2**30))
+        in_b = jnp.where(valid, got[:, 1], jnp.float32(0.0))
+    else:
+        px_b = jnp.where(valid, px_s[src], jnp.int32(2**30))
+        in_b = jnp.where(valid, in_s[src], jnp.float32(0.0))
     return px_b, in_b
 
 
