@@ -1,0 +1,110 @@
+"""Cold-path wall-clock for BASELINE eval config #3 (VERDICT r3 item 7).
+
+One measured END-TO-END SearchJob at ~80k formulas with a COLD isocalc
+cache: staging + parse + decoy selection + isotope-pattern generation +
+scoring + FDR + storage, on a ~10^4-pixel section.  Everything before this
+script only quoted the warm, per-phase pieces; BASELINE #3's wall-clock
+includes pattern generation on a cold cache, so this measures exactly that.
+
+The dataset embeds signal for ~1% of formulas (a tissue section contains a
+tiny fraction of HMDB+LipidMaps, ref: SURVEY.md §6 config #3 [U]); the
+other 99% still cost full pattern generation + scoring + decoy ranking,
+which is the point.
+
+Prints ONE JSON line; logs to stderr.  Runtime is dominated by isocalc on
+this 1-core host (~75 core-minutes at 80k formulas x21 decoy+target
+adducts) — run it solo so the wall-clock is honest.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-formulas", type=int, default=80_000)
+    ap.add_argument("--nrows", type=int, default=100)
+    ap.add_argument("--ncols", type=int, default=100)
+    ap.add_argument("--decoy-sample-size", type=int, default=20)
+    ap.add_argument("--present", type=int, default=800,
+                    help="formulas with embedded spatial signal")
+    ap.add_argument("--work-dir", default="",
+                    help="job work dir (default: .cache/cold_path; the "
+                         "isocalc cache inside is REMOVED first — that's "
+                         "the 'cold' in cold path)")
+    args = ap.parse_args()
+
+    from sm_distributed_tpu.io.fixtures import (
+        expand_formula_list,
+        generate_synthetic_dataset,
+    )
+    from sm_distributed_tpu.utils.logger import init_logger, logger
+
+    init_logger()
+    root = Path(args.work_dir or Path(__file__).parent.parent
+                / ".cache" / "cold_path")
+    root.mkdir(parents=True, exist_ok=True)
+
+    formulas = expand_formula_list(args.n_formulas)
+    t0 = time.perf_counter()
+    ds_path, _truth = generate_synthetic_dataset(
+        root / "ds", nrows=args.nrows, ncols=args.ncols,
+        formulas=formulas[: args.present], present_fraction=1.0,
+        noise_peaks=200, seed=11, reuse=True)
+    logger.info("fixture: %dx%d px, %d signal formulas (%.1fs)",
+                args.nrows, args.ncols, args.present,
+                time.perf_counter() - t0)
+
+    # cold cache: the whole point of this measurement
+    import shutil
+
+    job_work = root / "work"
+    for stale in (job_work / "isocalc_cache", root / "results"):
+        shutil.rmtree(stale, ignore_errors=True)
+
+    from sm_distributed_tpu.engine.search_job import SearchJob
+    from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+    sm_config = SMConfig.from_dict({
+        "backend": "jax_tpu",
+        "fdr": {"decoy_sample_size": args.decoy_sample_size},
+        "storage": {"results_dir": str(root / "results"),
+                    "store_images": False},
+        "work_dir": str(job_work),
+    })
+    ds_config = DSConfig.from_dict({
+        "isotope_generation": {"adducts": ["+H"]},
+        "image_generation": {"ppm": 3.0},
+    })
+
+    t0 = time.perf_counter()
+    job = SearchJob("cold3", "cold-path-config3", ds_path, ds_config,
+                    sm_config, formulas=formulas)
+    bundle = job.run()
+    wall = time.perf_counter() - t0
+
+    t = bundle.timings
+    isocalc_s = t.get("isotope_patterns", 0.0)
+    out = {
+        "metric": "cold_path_config3_wall_clock",
+        "unit": "s",
+        "value": round(wall, 1),
+        "n_formulas": args.n_formulas,
+        "n_ions": int(bundle.all_metrics.shape[0]),
+        "n_pixels": args.nrows * args.ncols,
+        "isocalc_s": round(isocalc_s, 1),
+        "isocalc_share": round(isocalc_s / wall, 3) if wall else None,
+        "phases_s": {k: round(v, 1) for k, v in sorted(t.items())},
+        "n_annotations_fdr10": int((bundle.annotations["fdr"] <= 0.1).sum())
+        if len(bundle.annotations) else 0,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

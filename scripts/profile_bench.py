@@ -61,9 +61,11 @@ def profile(nrows=64, ncols=64, formula_batch=512, noise_peaks=200, reps=5,
     cache_dir = Path(cache_dir or Path(__file__).parent.parent / ".cache")
     formulas = (expand_formula_list(n_formulas) if n_formulas
                 else FIXTURE_FORMULAS)
-    # n_formulas mode mirrors bench.py's exact fixture params, so reuse its
-    # cached dataset (a 256x256 generation costs ~4 min)
-    name = "bench_ds" if n_formulas else f"profile_ds_{nrows}x{ncols}"
+    # n_formulas mode mirrors bench.py's exact fixture params AND its cache
+    # naming, so the profiler reuses bench datasets (a 512x512 generation
+    # costs ~11 min on this host)
+    name = (f"bench_ds_{nrows}x{ncols}_f{n_formulas}" if n_formulas
+            else f"profile_ds_{nrows}x{ncols}")
     path, truth = generate_synthetic_dataset(
         cache_dir / name, nrows=nrows, ncols=ncols,
         formulas=formulas, present_fraction=0.6,

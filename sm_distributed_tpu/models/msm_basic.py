@@ -287,6 +287,11 @@ class MSMBasicSearch:
             pairs, flags = assignment.all_ion_tuples(self.formulas, iso_cfg.adducts)
         with phase_timer("isotope_patterns", timings):
             table = self.isocalc.pattern_table(pairs, flags)
+        if self.sm_config.parallel.order_ions == "mz":
+            # m/z-localized batch unions (see order_table_by_mz): per-ion
+            # results are order-independent, so this only changes which
+            # extraction variant each batch's plan picks
+            table = order_table_by_mz(table)
         self.last_table = table
         logger.info(
             "scoring %d ions (%d targets, %d decoys) with backend=%s",
@@ -303,7 +308,7 @@ class MSMBasicSearch:
             par = self.sm_config.parallel
             key = (self.sm_config.backend, self._fingerprint(table),
                    par.mz_chunk, par.pixels_axis, par.formulas_axis,
-                   par.peak_compaction)
+                   par.peak_compaction, par.band_slice, par.order_ions)
             backend = self.backend_cache.backend(key, build)
         else:
             backend = build()

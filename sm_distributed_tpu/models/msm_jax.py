@@ -23,6 +23,7 @@ from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
 )
 from ..ops.imager_jax import (
+    batch_peak_band,
     batch_peak_runs,
     compact_peaks,
     extract_images,
@@ -75,6 +76,67 @@ def fused_score_fn_flat_banded(
         gc_width=gc_width, n_pixels=nrows * ncols)
     # see fused_score_fn_flat_banded_compact: stop XLA from fusing the
     # extraction into the metric consumers (measured 3x regression at 65k px)
+    imgs = jax.lax.optimization_barrier(imgs)
+    imgs = imgs.reshape(b, k, -1)
+    return batch_metrics(
+        imgs, theor_ints, n_valid, nrows, ncols, nlevels,
+        do_preprocessing=do_preprocessing, q=q,
+    )
+
+
+def _extract_sliced(
+    pixel_sorted, int_sorted, w_start, pos_b,
+    starts, r_lo_loc, r_hi_loc, inv, *, w_cap, gc_width, n_pixels,
+):
+    """Band slice + banded extraction (the first half of
+    fused_score_fn_flat_banded_sliced) as a standalone probe phase."""
+    px_b = jax.lax.dynamic_slice(pixel_sorted, (w_start,), (w_cap,))
+    in_b = jax.lax.dynamic_slice(int_sorted, (w_start,), (w_cap,))
+    return extract_images_flat_banded(
+        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, inv,
+        gc_width=gc_width, n_pixels=n_pixels)
+
+
+def fused_score_fn_flat_banded_sliced(
+    pixel_sorted: jnp.ndarray,  # (N,) int32 resident peaks
+    int_sorted: jnp.ndarray,   # (N,) f32
+    w_start: jnp.ndarray,      # () i32 band start rank (host-clamped)
+    pos_b: jnp.ndarray,        # (G,) i32 band-space bound ranks
+    starts: jnp.ndarray,       # (C,) chunk grid offsets
+    r_lo_loc: jnp.ndarray,     # (C, Wc)
+    r_hi_loc: jnp.ndarray,     # (C, Wc)
+    inv: jnp.ndarray,          # (B*K,)
+    theor_ints: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    *,
+    w_cap: int,
+    gc_width: int,
+    b: int,
+    k: int,
+    nrows: int,
+    ncols: int,
+    nlevels: int,
+    do_preprocessing: bool,
+    q: float,
+) -> jnp.ndarray:
+    """Flat-banded scoring over a CONTIGUOUS band slice of the resident
+    peaks.  With an m/z-ordered ion table (parallel.order_ions="mz") each
+    batch's window union spans a narrow contiguous rank band, so extraction
+    can scatter a dynamic_slice of the resident arrays directly: scatter
+    cost is per-band-peak (like compaction) but WITHOUT the packed-run
+    gather (measured ~23 ns/slot, i.e. ~60% of the compact path's cost at
+    DESI scale).  Peaks inside the slice but outside every window land in
+    gap bins with zero band membership, and ``pos_b`` is host-shifted with
+    padding bounds clipped to 0 — both exactly mirror how the full plain
+    path treats peaks before/after/between windows, so images (and hence
+    metrics) are bit-identical to the uncompacted path."""
+    px_b = jax.lax.dynamic_slice(pixel_sorted, (w_start,), (w_cap,))
+    in_b = jax.lax.dynamic_slice(int_sorted, (w_start,), (w_cap,))
+    imgs = extract_images_flat_banded(
+        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, inv,
+        gc_width=gc_width, n_pixels=nrows * ncols)
+    # see fused_score_fn_flat_banded_compact: stop XLA from fusing the
+    # extraction into the metric consumers
     imgs = jax.lax.optimization_barrier(imgs)
     imgs = imgs.reshape(b, k, -1)
     return batch_metrics(
@@ -338,6 +400,9 @@ class JaxBackend:
             self._fn_c = jax.jit(
                 partial(fused_score_fn_flat_banded_compact, **common),
                 static_argnames=("n_keep", "gc_width", "b", "k"))
+            self._fn_bs = jax.jit(
+                partial(fused_score_fn_flat_banded_sliced, **common),
+                static_argnames=("w_cap", "gc_width", "b", "k"))
             # sticky static shapes: grow to the max seen so one executable
             # serves (almost) all batches instead of recompiling per batch
             self._gc_width = 0
@@ -345,6 +410,7 @@ class JaxBackend:
             self._n_keep = 0          # compacted peak capacity
             self._r_pad = 0           # compaction run-list capacity
             self._compaction = sm_config.parallel.peak_compaction
+            self._band_mode = sm_config.parallel.band_slice
 
     # static batch size for SMALL tables (the stream's tail): a 212-ion
     # final slice padded to formula_batch=2048 pays the full batch's
@@ -387,21 +453,53 @@ class JaxBackend:
         grid, r_lo, r_hi, ints_p, nv_p = self._padded_windows(table, b_eff)
         chunks = window_chunks(r_lo, r_hi, _BAND_WINDOWS)
         pos = flat_bound_ranks(self._mz_host, grid)
-        runs = None
-        if self._compaction != "off":
+        runs, band = None, None
+        if self._compaction != "off" or self._band_mode != "off":
             lo_q, hi_q = quantize_window(table.mzs, self.ppm)
-            runs = batch_peak_runs(self._mz_host, lo_q, hi_q, pos)
-        return (grid, r_lo, r_hi, ints_p, nv_p, chunks, pos, runs, b_eff)
+            if self._compaction != "off":
+                runs = batch_peak_runs(self._mz_host, lo_q, hi_q, pos)
+            if self._band_mode != "off":
+                band = batch_peak_band(self._mz_host, lo_q, hi_q)
+        return (grid, r_lo, r_hi, ints_p, nv_p, chunks, pos, runs, b_eff,
+                band)
 
-    def _use_compaction(self, runs) -> bool:
-        """Compaction wins when a batch touches a minority of the resident
-        peaks (many-batch searches); on a near-full batch the extra gather
-        would only add cost.  'on'/'off' force the choice for tests."""
-        if runs is None or self._compaction == "off":
-            return False
-        if self._compaction == "on":
-            return True
-        return runs[2] <= 0.7 * self._mz_host.size
+    # band-slice w_cap buckets are powers of two with a floor: each bucket
+    # is one (cached) executable, and the pow-2 rounding bounds padded
+    # scatter waste at 2x while keeping the compile count logarithmic
+    _BAND_MIN = 1 << 21
+
+    def _band_bucket(self, width: int) -> int:
+        cap = self._BAND_MIN
+        while cap < width:
+            cap <<= 1
+        return cap
+
+    def _variant_for(self, runs, band) -> str:
+        """Pick the extraction variant for one batch: 'band' (scatter a
+        contiguous dynamic slice of the resident peaks), 'compact' (gather
+        the packed window-union runs, then scatter), or 'plain' (scatter
+        everything).  Auto mode minimizes estimated scatter/gather cost
+        with the measured v5e per-slot rates (docs/PERF.md: scatter ~14
+        ns/slot, packed-run gather ~23 ns/slot -> compact ~37 ns per
+        capacity slot); 'on' modes force a variant for tests, band first."""
+        if self._band_mode == "on" and band is not None:
+            return "band"
+        if self._compaction == "on" and runs is not None:
+            return "compact"
+        n = int(self._mz_host.size)
+        est = {"plain": 14.0 * n}
+        if runs is not None and self._compaction != "off":
+            # charge the PADDED capacity, like the band branch: dispatch
+            # pads every compact batch to the sticky 64k-rounded stream
+            # max, and padded slots gather+scatter all the same
+            cap_c = max(-(-max(runs[2], 1) // (1 << 16)) * (1 << 16),
+                        self._n_keep)
+            est["compact"] = 37.0 * min(cap_c, n)
+        if band is not None and self._band_mode != "off":
+            cap = self._band_bucket(band[1])
+            if cap < n:
+                est["band"] = 14.0 * cap
+        return min(est, key=est.get)
 
     def _grow_compact_capacity(self, runs) -> None:
         # clamp at the resident peak count: padded slots still gather and
@@ -422,7 +520,7 @@ class JaxBackend:
         if flat_plan is None:
             flat_plan = self._flat_plan(table)
         (_grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs,
-         b_eff) = flat_plan
+         b_eff, band) = flat_plan
         starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
         # the tail executable keeps its own sticky band width: sharing
         # the full-size band would blow the small batch's matmul cost
@@ -432,9 +530,25 @@ class JaxBackend:
         else:
             self._gc_tail = max(self._gc_tail, gc_width)
             gc_eff = self._gc_tail
+        variant = self._variant_for(runs, band)
         # explicit async device_put: the transfers overlap device compute
         # of previously enqueued batches instead of blocking dispatch
-        if self._use_compaction(runs):
+        if variant == "band":
+            b_lo, b_w = band
+            n = int(self._mz_host.size)
+            cap = min(self._band_bucket(b_w), n)
+            # clamp so the static-width slice stays inside the resident
+            # array; bounds below w_start are batch-padding zeros — clip
+            # them to 0, which mirrors the full path exactly (their grid
+            # entry ranks below every real window)
+            w_start = max(0, min(b_lo, n - cap))
+            pos_b = np.clip(pos - w_start, 0, cap).astype(np.int32)
+            args = [jax.device_put(a) for a in (
+                np.int32(w_start), pos_b,
+                starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
+            statics = dict(w_cap=cap, gc_width=gc_eff, b=b_eff, k=k)
+            return "band", args, statics
+        if variant == "compact":
             run_pos, run_delta, n_b, pos_b = runs
             self._grow_compact_capacity(runs)
             rp = np.full(self._r_pad, self._n_keep, np.int32)
@@ -446,10 +560,10 @@ class JaxBackend:
                 starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
             statics = dict(n_keep=self._n_keep, gc_width=gc_eff,
                            b=b_eff, k=k)
-            return True, args, statics
+            return "compact", args, statics
         args = [jax.device_put(a) for a in (
             pos, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
-        return False, args, dict(gc_width=gc_eff, b=b_eff, k=k)
+        return "plain", args, dict(gc_width=gc_eff, b=b_eff, k=k)
 
     def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
         """Async: enqueue one padded batch on device, return (device_out, n)."""
@@ -463,8 +577,9 @@ class JaxBackend:
             out = self._fn(self._mz_q, self._ints, *args,
                            gc_width=gc_width, b=b, k=k)
         else:
-            compact, args, statics = self._flat_call(table, flat_plan)
-            fn = self._fn_c if compact else self._fn
+            variant, args, statics = self._flat_call(table, flat_plan)
+            fn = {"plain": self._fn, "compact": self._fn_c,
+                  "band": self._fn_bs}[variant]
             out = fn(self._px_s, self._in_s, *args, **statics)
         return out, n
 
@@ -480,17 +595,22 @@ class JaxBackend:
             return {"fused_full": lambda: self._dispatch(table)[0]}, {
                 "path": "mz_chunk"}
         plan = self._flat_plan(table)
-        compact, args, statics = self._flat_call(table, plan)
-        fn = self._fn_c if compact else self._fn
+        variant, args, statics = self._flat_call(table, plan)
+        fn = {"plain": self._fn, "compact": self._fn_c,
+              "band": self._fn_bs}[variant]
         phases = {"fused_full": lambda: fn(
             self._px_s, self._in_s, *args, **statics)}
         img_cfg = self.ds_config.image_generation
         ext_statics = {kk: v for kk, v in statics.items()
-                       if kk in ("n_keep", "gc_width")}
+                       if kk in ("n_keep", "w_cap", "gc_width")}
         ext_fn = jax.jit(partial(
-            _extract_compact if compact else extract_images_flat_banded,
+            {"plain": extract_images_flat_banded,
+             "compact": _extract_compact,
+             "band": _extract_sliced}[variant],
             n_pixels=self.ds.n_pixels, **ext_statics))
-        ext_args = args[: 8 if compact else 5]   # drop (theor_ints, n_valid)
+        # extraction args = everything before (theor_ints, n_valid)
+        n_ext = {"plain": 5, "compact": 8, "band": 6}[variant]
+        ext_args = args[:n_ext]
         phases["extract"] = lambda: ext_fn(
             self._px_s, self._in_s, *ext_args)
         imgs = phases["extract"]().reshape(
@@ -507,9 +627,10 @@ class JaxBackend:
         pat_fn = jax.jit(lambda im, th, v: isotope_pattern_match_batch(
             im.sum(-1), th, v))
         phases["pattern"] = lambda: pat_fn(imgs, ints_p, valid_d)
-        info = dict(path="flat", compact=compact, **statics,
+        pos_ix = {"plain": 0, "compact": 3, "band": 1}[variant]
+        info = dict(path="flat", variant=variant, **statics,
                     resident_peaks=int(self._px_s.shape[0]),
-                    grid_bins=int(args[3 if compact else 0].shape[0]))
+                    grid_bins=int(args[pos_ix].shape[0]))
         return phases, info
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
@@ -574,7 +695,7 @@ class JaxBackend:
             self._gc_width = max(self._gc_width, plan[5][4])
         else:
             self._gc_tail = max(self._gc_tail, plan[5][4])
-        if self._use_compaction(plan[7]):
+        if self._variant_for(plan[7], plan[9]) == "compact":
             self._grow_compact_capacity(plan[7])
 
     def warmup(self, tables) -> None:
@@ -592,7 +713,11 @@ class JaxBackend:
             self._grow_from_plan(plan)
         reps, seen = [], set()
         for t, plan in zip(tables, plans):
-            kind = (self._use_compaction(plan[7]), plan[8])
+            variant = self._variant_for(plan[7], plan[9])
+            # each band w_cap bucket is its own executable
+            bucket = (self._band_bucket(plan[9][1])
+                      if variant == "band" else 0)
+            kind = (variant, plan[8], bucket)
             if kind not in seen:
                 seen.add(kind)
                 reps.append((t, plan))

@@ -163,6 +163,16 @@ def _chaos_kernel(img_ref, vmax_ref, out_ref, *, ncols: int, nlevels: int,
 _MAX_CELLS = 96 * 1024
 _MAX_CELLS_LEAN = 288 * 1024
 
+# Strip-kernel budget: cells of ONE strip block (strip_rows + 2*_HALO rows x
+# padded cols).  Live arrays per strip visit: the two persistent scratches
+# (image f32 + labels i32) plus the sweep transients (lab_in, shifted
+# copies, flags) — leaner liveness than the packed kernel's per-level
+# hoists, but two resident scratches, so the budget sits between _MAX_CELLS
+# and _MAX_CELLS_LEAN.
+_MAX_CELLS_STRIP = 192 * 1024
+_HALO = 4                     # halo rows above/below a strip (keeps blocks
+                              # sublane-aligned; extra rows only help flow)
+
 
 def _pack_geometry(nrows: int, ncols: int, lane_width: int,
                    max_cells: int = _MAX_CELLS) -> tuple[int, int, int]:
@@ -252,3 +262,237 @@ def chaos_count_sums(
     )(img_l, vmax_l)
     # per-image count sum: reduce each image's cp lanes
     return counts.reshape(n_pad, cp).sum(axis=1)[:n].astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Strip-processed kernel: images beyond the lean whole-image budget
+# (>~288k cells, e.g. 1024x1024 whole-slide DESI) — VERDICT r3 item 4b.
+#
+# The image and a label plane live in HBM; row strips (with _HALO read-only
+# halo rows on each side) stream through VMEM, each swept to its LOCAL
+# fixpoint with the same segmented min-scans as the packed kernel.  Passes
+# alternate top-down / bottom-up over the strips and repeat until one
+# complete pass changes no core label — a valid GLOBAL certificate: every
+# halo row is some neighbor's core row, so any pixel unstable against the
+# end-of-pass state would have changed during its own strip's visit.
+#
+# Correctness anchors:
+# - labels only ever DECREASE toward the component min (min-label flood);
+#   reading a STALE halo value is therefore always an upper bound of the
+#   true min and can never poison a component (monotone convergence);
+# - the on-load transform  lab = where(mask, min(lab, iota), BIG)  is
+#   idempotent and level-monotone (masks only grow descending levels), so
+#   warm starts across levels need no per-level init or write-back: a strip
+#   whose sweep changed nothing is simply not written, and the count pass
+#   re-applies the transform on load;
+# - empty strips (per-strip max <= threshold) are skipped without DMA:
+#   masks grow monotonically going down levels, so a strip empty at this
+#   level was empty at every earlier level and its labels are still the
+#   init-pass BIG.
+# ---------------------------------------------------------------------------
+
+
+def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
+                        lab_vmem, sems, *, ncols: int, nrows_pad: int,
+                        strip_rows: int, nlevels: int, work_span: int):
+    """One program: one image, (nrows_pad + 2*_HALO, ncols) in HBM."""
+    pid = pl.program_id(0)
+    n_strips = nrows_pad // strip_rows
+    rb = strip_rows + 2 * _HALO                       # block rows
+    shape = (rb, ncols)
+    lrow = lax.broadcasted_iota(jnp.int32, shape, 0)
+    col = lax.broadcasted_iota(jnp.int32, shape, 1)
+    core = (lrow >= _HALO) & (lrow < _HALO + strip_rows)
+    vmax = smax_ref[0, n_strips]
+
+    def load_strip(s, *, want_img: bool):
+        r0 = s * strip_rows
+        cp_l = pltpu.make_async_copy(
+            lab_hbm.at[pl.ds(r0, rb), :], lab_vmem, sems.at[0])
+        cp_l.start()
+        if want_img:
+            cp_i = pltpu.make_async_copy(
+                img_ref.at[pid, pl.ds(r0, rb), :], img_vmem, sems.at[1])
+            cp_i.start()
+            cp_i.wait()
+        cp_l.wait()
+
+    def giota(s):
+        # global pixel id of each block cell (halo rows get their true ids
+        # too — assigning a masked halo pixel its own iota is always a valid
+        # upper bound of its component min, and accelerates convergence)
+        return (s * strip_rows + lrow - _HALO) * ncols + col
+
+    # ---- init: labels <- BIG everywhere (strip writes overlap on halos;
+    # same value, so overlap is harmless) ----
+    lab_vmem[:] = jnp.full(shape, _BIG, jnp.int32)
+
+    def init_body(s, _):
+        cp = pltpu.make_async_copy(
+            lab_vmem, lab_hbm.at[pl.ds(s * strip_rows, rb), :], sems.at[0])
+        cp.start()
+        cp.wait()
+        return _
+
+    lax.fori_loop(0, n_strips, init_body, 0)
+
+    def sweep_strip(mask, lab, span):
+        mi = mask.astype(jnp.int32)
+        lab = _seg_min_scan(lab, mi, 1, False,
+                            span=min(span or ncols, ncols))
+        lab = _seg_min_scan(lab, mi, 1, True,
+                            span=min(span or ncols, ncols))
+        lab = _seg_min_scan(lab, mi, 0, False, span=min(span or rb, rb))
+        lab = _seg_min_scan(lab, mi, 0, True, span=min(span or rb, rb))
+        return jnp.where(mask, lab, _BIG)
+
+    def level_body(li_rev, acc):
+        li = nlevels - 1 - li_rev                     # descending thresholds
+        thr = vmax * (li.astype(jnp.float32) / np.float32(nlevels))
+
+        def visit(s):
+            """Returns True when the strip's core labels changed (written)."""
+            load_strip(s, want_img=True)
+            mask = img_vmem[:] > thr
+            lab_in = jnp.where(mask, jnp.minimum(lab_vmem[:], giota(s)), _BIG)
+
+            def body(st):
+                lab, _ = st
+                c = sweep_strip(mask, lab, 2)         # cheap certificate
+                moved = jnp.any(c != lab)
+                lab = lax.cond(
+                    moved, lambda l: sweep_strip(mask, l, work_span),
+                    lambda l: l, c)
+                return lab, moved
+
+            lab_fin, _ = lax.while_loop(lambda st: st[1], body,
+                                        (lab_in, jnp.array(True)))
+            changed = jnp.any((lab_fin != lab_in) & core)
+
+            @pl.when(changed)
+            def _():
+                lab_vmem[:] = lab_fin
+                cp = pltpu.make_async_copy(
+                    lab_vmem.at[pl.ds(_HALO, strip_rows), :],
+                    lab_hbm.at[pl.ds(s * strip_rows + _HALO, strip_rows), :],
+                    sems.at[0])
+                cp.start()
+                cp.wait()
+
+            return changed
+
+        def pass_body(st):
+            p, _ = st
+
+            def strip_body(i, any_changed):
+                # alternate top-down / bottom-up passes so flows in either
+                # direction cascade across all boundaries within one pass
+                s = jnp.where(p % 2 == 0, i, n_strips - 1 - i)
+                nonempty = smax_ref[0, s] > thr
+                ch = lax.cond(nonempty, visit, lambda _s: jnp.array(False), s)
+                return jnp.logical_or(any_changed, ch)
+
+            changed = lax.fori_loop(0, n_strips, strip_body, jnp.array(False))
+            return p + 1, changed
+
+        lax.while_loop(lambda st: st[1], pass_body,
+                       (jnp.int32(0), jnp.array(True)))
+
+        # ---- count roots: label == own iota (transform re-applied on load
+        # because converged strips skip write-back) ----
+        def count_body(s, lvl_acc):
+            def counted(s):
+                load_strip(s, want_img=True)
+                mask = img_vmem[:] > thr
+                gi = giota(s)
+                lab = jnp.where(mask, jnp.minimum(lab_vmem[:], gi), _BIG)
+                return jnp.sum((core & mask & (lab == gi)).astype(jnp.int32))
+
+            return lvl_acc + lax.cond(smax_ref[0, s] > thr, counted,
+                                      lambda _s: jnp.int32(0), s)
+
+        return acc + lax.fori_loop(0, n_strips, count_body, jnp.int32(0))
+
+    out_ref[0, 0] = lax.fori_loop(0, nlevels, level_body, jnp.int32(0))
+
+
+def _strip_geometry(nrows: int, ncols: int,
+                    strip_rows: int | None = None) -> tuple[int, int, int]:
+    """(nrows_pad, ncols_pad, strip_rows) for the strip kernel.
+
+    ``strip_rows`` overrides the budget-derived strip height (multiple of 8;
+    tests use it to exercise multi-strip flows on small images)."""
+    cp = -(-ncols // 128) * 128
+    strip = (_MAX_CELLS_STRIP // cp - 2 * _HALO) // 8 * 8
+    if strip_rows is not None:
+        strip = strip_rows
+    if strip < 8 or strip % 8:
+        raise ValueError(
+            f"no valid strip height for the strip chaos kernel: {ncols} "
+            f"cols (padded {cp}) with strip_rows={strip} against the "
+            f"{_MAX_CELLS_STRIP}-cell budget")
+    strip = min(strip, -(-nrows // 8) * 8)
+    rp = -(-nrows // strip) * strip
+    return rp, cp, strip
+
+
+def chaos_route(nrows: int, ncols: int, lane_width: int = 512) -> str:
+    """'packed' (whole image(s) in VMEM), 'strips' (HBM-resident labels,
+    strips through VMEM), or 'scan' (associative-scan fallback)."""
+    if fits_vmem(nrows, ncols, lane_width):
+        return "packed"
+    try:
+        _strip_geometry(nrows, ncols)
+        return "strips"
+    except ValueError:
+        return "scan"
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "nrows", "ncols", "nlevels", "interpret", "work_span", "strip_rows"))
+def chaos_count_sums_strips(
+    principal: jnp.ndarray,   # (N, n_pix) f32, n_pix == nrows*ncols
+    *,
+    nrows: int,
+    ncols: int,
+    nlevels: int = 30,
+    interpret: bool = False,
+    work_span: int = 32,
+    strip_rows: int | None = None,
+) -> jnp.ndarray:
+    """(N,) f32 per-image SUM over levels of component counts — the strip
+    kernel's twin of chaos_count_sums, for images beyond the lean budget."""
+    n = principal.shape[0]
+    rp, cp, strip = _strip_geometry(nrows, ncols, strip_rows)
+    n_strips = rp // strip
+    # guard/pad fill is -1: masks are img > thr with thr >= 0, so guard
+    # rows, halo overhang and col padding can never enter a component
+    img = jnp.full((n, rp + 2 * _HALO, cp), -1.0, jnp.float32)
+    img = img.at[:, _HALO:_HALO + nrows, :ncols].set(
+        jnp.maximum(principal.reshape(n, nrows, ncols), 0.0))
+    body = img[:, _HALO:_HALO + rp, :]
+    smax = body.reshape(n, n_strips, strip * cp).max(axis=2)   # (N, S)
+    vmax = smax.max(axis=1, keepdims=True)                     # (N, 1)
+    smax_v = jnp.concatenate([smax, vmax], axis=1)             # (N, S+1)
+
+    counts = pl.pallas_call(
+        functools.partial(_chaos_strip_kernel, ncols=cp, nrows_pad=rp,
+                          strip_rows=strip, nlevels=nlevels,
+                          work_span=work_span),
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, n_strips + 1), lambda i: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        scratch_shapes=[
+            pltpu.HBM((rp + 2 * _HALO, cp), jnp.int32),
+            pltpu.VMEM((strip + 2 * _HALO, cp), jnp.float32),
+            pltpu.VMEM((strip + 2 * _HALO, cp), jnp.int32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(smax_v, img)
+    return counts.reshape(n).astype(jnp.float32)

@@ -281,6 +281,23 @@ def prepare_flat_sharded_arrays(
     return mz_s, px_s, in_s, p_loc
 
 
+def batch_peak_band(mz_host: np.ndarray, lo_q: np.ndarray,
+                    hi_q: np.ndarray) -> tuple[int, int]:
+    """Host-side: the CONTIGUOUS rank band [start, start+width) of the
+    sorted resident peaks spanned by a batch's window union.  For an
+    m/z-ordered ion table every batch's union is m/z-localized, so the band
+    is narrow; extraction can then scatter a dynamic slice of the resident
+    arrays directly (no per-run gather) — see
+    models/msm_jax.py::fused_score_fn_flat_banded_sliced."""
+    flat = merged_window_bounds(lo_q, hi_q)
+    if flat.size == 0:
+        return 0, 0
+    cuts = np.searchsorted(
+        mz_host, np.array([flat[0], flat[-1]], dtype=mz_host.dtype),
+        side="left")
+    return int(cuts[0]), int(cuts[1] - cuts[0])
+
+
 def merged_window_bounds(lo_q: np.ndarray, hi_q: np.ndarray) -> np.ndarray:
     """Host-side: the union of half-open quantized windows [lo, hi) as a
     flat sorted boundary array [lo1, hi1, lo2, hi2, ...] of DISJOINT

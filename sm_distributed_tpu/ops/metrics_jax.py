@@ -92,21 +92,37 @@ def measure_of_chaos_batch(
     (CPU test meshes, interpreters) the scan path below is used.  Both are
     exact, so the dispatch cannot change results.
     """
-    if use_pallas is None:
-        from .chaos_pallas import fits_vmem
+    from .chaos_pallas import chaos_route
 
-        # pallas needs the whole image's connectivity in one VMEM block;
-        # images whose padded (rows x lanes) block exceeds the scoped-VMEM
-        # budget (~96k cells: e.g. 256x385+ or 512x193+) take the scan path
-        use_pallas = jax.default_backend() == "tpu" and fits_vmem(nrows, ncols)
+    if use_pallas is None:
+        # 'packed': whole image(s) resident in one VMEM block (fast path);
+        # 'strips': beyond the lean whole-image budget (>~288k cells, e.g.
+        # 1024x1024 whole-slide DESI) — HBM-resident labels, row strips
+        # through VMEM; 'scan': associative-scan fallback (CPU meshes,
+        # interpreters, absurd widths).  All three are exact, so the
+        # dispatch cannot change results.
+        route = (chaos_route(nrows, ncols)
+                 if jax.default_backend() == "tpu" else "scan")
+    elif use_pallas:
+        route = chaos_route(nrows, ncols)
+        if route == "scan":
+            raise ValueError(
+                f"no pallas chaos route fits {nrows}x{ncols} images")
+    else:
+        route = "scan"
     principal = jnp.maximum(principal, 0.0)
     vmax = principal.max(axis=1)                       # (N,)
     n_notnull = jnp.sum(principal > 0, axis=1)         # (N,)
 
-    if use_pallas:
+    if route == "packed":
         from .chaos_pallas import chaos_count_sums
 
         count_sums = chaos_count_sums(
+            principal, nrows=nrows, ncols=ncols, nlevels=nlevels)
+    elif route == "strips":
+        from .chaos_pallas import chaos_count_sums_strips
+
+        count_sums = chaos_count_sums_strips(
             principal, nrows=nrows, ncols=ncols, nlevels=nlevels)
     else:
         def per_level(_, frac):

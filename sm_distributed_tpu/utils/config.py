@@ -122,6 +122,19 @@ class ParallelConfig:
     # inside the current batch's window union (auto = on when the planned
     # batches keep <70% of resident peaks; on/off force it)
     peak_compaction: str = "auto"
+    # ion-table ordering before batching: "mz" sorts ions by principal-peak
+    # m/z so each batch's window union is an m/z-LOCALIZED band (total
+    # histogram-scatter work across a many-batch stream drops from
+    # ~n_batches x resident toward ~resident — the BASELINE #5 regime);
+    # "table" keeps the caller's order (targets first).  Per-ion results
+    # are identical either way.
+    order_ions: str = "mz"
+    # contiguous band-slice extraction: when a batch's window union spans a
+    # contiguous slice of the m/z-sorted resident peaks (ordered streams),
+    # scatter a dynamic slice instead of gathering a packed run list —
+    # scatter-only cost, no 23 ns/slot gather.  auto = picked per batch by
+    # measured-cost estimate vs plain/compaction; on/off force or disable.
+    band_slice: str = "auto"
     # multi-host (DCN) runtime — jax.distributed.initialize; the analog of
     # the reference's spark.master cluster address (SURVEY.md §5.8).  Env
     # vars SM_COORDINATOR / SM_NUM_PROCESSES / SM_PROCESS_ID override.

@@ -371,6 +371,102 @@ def test_peak_compaction_bit_exact(fixture_ds):
     assert list(zip(a_on.sf, a_on.adduct)) == list(zip(a_np.sf, a_np.adduct))
 
 
+def test_band_slice_bit_exact(fixture_ds):
+    """Contiguous band-slice extraction (scatter a dynamic slice of the
+    resident peaks instead of gathering packed runs) must leave every
+    scored bit unchanged — forced on vs off, with and without the search
+    window-union restriction, on an m/z-ORDERED table (its natural regime)
+    AND the unordered table (stress: wide bands, clamped w_start,
+    clipped padding bounds)."""
+    from sm_distributed_tpu.models.msm_basic import (
+        _slice_table, order_table_by_mz,
+    )
+    from sm_distributed_tpu.models.msm_jax import JaxBackend
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    ds, truth = fixture_ds
+    calc = IsocalcWrapper(IsotopeGenerationConfig(adducts=("+H",)))
+    table = calc.pattern_table([(sf, "+H") for sf in truth.formulas[:15]])
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+
+    def mk(mode, restrict=None):
+        sm_config = SMConfig.from_dict(
+            {"backend": "jax_tpu",
+             "parallel": {"formula_batch": 8, "band_slice": mode}})
+        return JaxBackend(ds, ds_config, sm_config, restrict_table=restrict)
+
+    for t in (order_table_by_mz(table), table):
+        batches = [_slice_table(t, s, min(s + 8, t.n_ions))
+                   for s in range(0, t.n_ions, 8)]
+        plain = mk("off").score_batches(batches)
+        band = mk("on").score_batches(batches)
+        for a, b in zip(plain, band):
+            np.testing.assert_array_equal(a, b)
+        band_r = mk("on", restrict=t).score_batches(batches)
+        plain_r = mk("off", restrict=t).score_batches(batches)
+        for a, b in zip(plain_r, band_r):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_batch_peak_band_plan():
+    """Host band plan: [start, start+width) must cover exactly the rank
+    span of the window union, and clipped padding bounds keep windows
+    empty (all-padding batches get a zero-width band)."""
+    from sm_distributed_tpu.ops.imager_jax import (
+        batch_peak_band, merged_window_bounds,
+    )
+
+    rng = np.random.default_rng(12)
+    for _ in range(20):
+        mz = np.sort(rng.integers(0, 10_000, size=300)).astype(np.int32)
+        lo = rng.integers(0, 9_900, size=20).astype(np.int32)
+        hi = lo + rng.integers(0, 60, size=20).astype(np.int32)
+        start, width = batch_peak_band(mz, lo, hi)
+        flat = merged_window_bounds(lo, hi)
+        if flat.size == 0:
+            assert (start, width) == (0, 0)
+            continue
+        inside = (mz >= flat[0]) & (mz < flat[-1])
+        idx = np.nonzero(inside)[0]
+        if idx.size:
+            assert start <= idx[0] and idx[-1] < start + width
+        # every in-union peak is inside the band
+        member_lo = np.searchsorted(flat, mz, side="right") % 2 == 1
+        kept_idx = np.nonzero(member_lo)[0]
+        if kept_idx.size:
+            assert start <= kept_idx[0] and kept_idx[-1] < start + width
+    # all-padding batch
+    assert batch_peak_band(
+        np.arange(10, dtype=np.int32),
+        np.zeros(3, np.int32), np.zeros(3, np.int32)) == (0, 0)
+
+
+def test_order_table_by_mz_results_invariant(fixture_ds):
+    """parallel.order_ions="mz" (the default) reorders the ion table before
+    batching; the SET of (sf, adduct) -> metrics results must be identical
+    to order_ions="table"."""
+    from sm_distributed_tpu.models.msm_basic import MSMBasicSearch
+
+    ds, truth = fixture_ds
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+
+    def run(order):
+        sm = SMConfig.from_dict(
+            {"backend": "jax_tpu", "fdr": {"decoy_sample_size": 3},
+             "parallel": {"formula_batch": 8, "order_ions": order}})
+        return MSMBasicSearch(ds, list(truth.formulas[:10]), ds_config,
+                              sm).search()
+
+    a = run("mz").all_metrics.set_index(["sf", "adduct"]).sort_index()
+    b = run("table").all_metrics.set_index(["sf", "adduct"]).sort_index()
+    pd.testing.assert_frame_equal(a, b)
+
+
 def test_batch_peak_runs_plan_exact():
     """Host compaction plan: kept runs and re-based bound ranks agree with a
     brute-force recomputation on random windows over a random peak list."""

@@ -73,7 +73,11 @@ def test_sharded_matches_single_device(fixture_ds, pix, form):
     # BIT-EXACT: the all_to_all hands each device full-pixel images whose
     # values are exact integers on the shared intensity grid, and metrics
     # run the identical code on identical bits — sharding cannot change
-    # results, at any mesh shape
+    # results, at any mesh shape.  This is the single-PROCESS half of the
+    # parity contract; the multi-process half (chaos bit-exact,
+    # spatial/spectral 1e-6 — cross-process lowering fuses f32 reductions
+    # differently) is asserted by
+    # test_distributed.py::test_two_process_distributed_real.
     np.testing.assert_array_equal(got, want)
 
 
