@@ -37,6 +37,22 @@ def _slice_table(table: IsotopePatternTable, s: int, e: int) -> IsotopePatternTa
     )
 
 
+def maybe_order_table(table: IsotopePatternTable, order_ions: str,
+                      formula_batch: int) -> IsotopePatternTable:
+    """Apply parallel.order_ions: "mz" always orders, "table" never, "auto"
+    orders when the stream has >=6 batches — the measured crossover: m/z
+    locality won +20% at 6 batches (65k px) and 8.3x at 41 batches
+    (262k px), but lost 17% at 3 batches where there is no locality to win
+    and ordering spreads the blob-heavy target images' chaos cost across
+    every batch (docs/PERF.md ledger)."""
+    if order_ions == "mz":
+        return order_table_by_mz(table)
+    if order_ions == "table":
+        return table
+    n_batches = -(-table.n_ions // max(1, formula_batch))
+    return order_table_by_mz(table) if n_batches >= 6 else table
+
+
 def order_table_by_mz(table: IsotopePatternTable) -> IsotopePatternTable:
     """Reorder ions by principal-peak m/z (stable), targets and decoys
     interleaved.  Per-ion metrics are identical in any order (the window-
@@ -287,11 +303,12 @@ class MSMBasicSearch:
             pairs, flags = assignment.all_ion_tuples(self.formulas, iso_cfg.adducts)
         with phase_timer("isotope_patterns", timings):
             table = self.isocalc.pattern_table(pairs, flags)
-        if self.sm_config.parallel.order_ions == "mz":
-            # m/z-localized batch unions (see order_table_by_mz): per-ion
-            # results are order-independent, so this only changes which
-            # extraction variant each batch's plan picks
-            table = order_table_by_mz(table)
+        # m/z-localized batch unions (see maybe_order_table): per-ion
+        # results are order-independent, so this only changes which
+        # extraction variant each batch's plan picks
+        table = maybe_order_table(
+            table, self.sm_config.parallel.order_ions,
+            self.sm_config.parallel.formula_batch)
         self.last_table = table
         logger.info(
             "scoring %d ions (%d targets, %d decoys) with backend=%s",

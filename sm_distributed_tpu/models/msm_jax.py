@@ -241,6 +241,17 @@ def fused_score_fn_chunked(
     )
 
 
+# One row per extraction variant so the dispatch/probe sites cannot drift:
+# (jitted-scorer attr on JaxBackend, standalone extract fn, #args consumed
+# by extraction (the rest are (theor_ints, n_valid)), index of the
+# bound-ranks array in the args list)
+_VARIANTS = {
+    "plain": ("_fn", extract_images_flat_banded, 5, 0),
+    "compact": ("_fn_c", _extract_compact, 8, 3),
+    "band": ("_fn_bs", _extract_sliced, 6, 1),
+}
+
+
 def to_numpy_global(arr) -> np.ndarray:
     """Fetch a (possibly multi-process sharded) jax.Array to host numpy.
 
@@ -578,8 +589,7 @@ class JaxBackend:
                            gc_width=gc_width, b=b, k=k)
         else:
             variant, args, statics = self._flat_call(table, flat_plan)
-            fn = {"plain": self._fn, "compact": self._fn_c,
-                  "band": self._fn_bs}[variant]
+            fn = getattr(self, _VARIANTS[variant][0])
             out = fn(self._px_s, self._in_s, *args, **statics)
         return out, n
 
@@ -596,20 +606,16 @@ class JaxBackend:
                 "path": "mz_chunk"}
         plan = self._flat_plan(table)
         variant, args, statics = self._flat_call(table, plan)
-        fn = {"plain": self._fn, "compact": self._fn_c,
-              "band": self._fn_bs}[variant]
+        fn_attr, ext_base, n_ext, pos_ix = _VARIANTS[variant]
+        fn = getattr(self, fn_attr)
         phases = {"fused_full": lambda: fn(
             self._px_s, self._in_s, *args, **statics)}
         img_cfg = self.ds_config.image_generation
         ext_statics = {kk: v for kk, v in statics.items()
                        if kk in ("n_keep", "w_cap", "gc_width")}
         ext_fn = jax.jit(partial(
-            {"plain": extract_images_flat_banded,
-             "compact": _extract_compact,
-             "band": _extract_sliced}[variant],
-            n_pixels=self.ds.n_pixels, **ext_statics))
+            ext_base, n_pixels=self.ds.n_pixels, **ext_statics))
         # extraction args = everything before (theor_ints, n_valid)
-        n_ext = {"plain": 5, "compact": 8, "band": 6}[variant]
         ext_args = args[:n_ext]
         phases["extract"] = lambda: ext_fn(
             self._px_s, self._in_s, *ext_args)
@@ -627,7 +633,6 @@ class JaxBackend:
         pat_fn = jax.jit(lambda im, th, v: isotope_pattern_match_batch(
             im.sum(-1), th, v))
         phases["pattern"] = lambda: pat_fn(imgs, ints_p, valid_d)
-        pos_ix = {"plain": 0, "compact": 3, "band": 1}[variant]
         info = dict(path="flat", variant=variant, **statics,
                     resident_peaks=int(self._px_s.shape[0]),
                     grid_bins=int(args[pos_ix].shape[0]))

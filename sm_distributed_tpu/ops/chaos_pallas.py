@@ -170,8 +170,10 @@ _MAX_CELLS_LEAN = 288 * 1024
 # hoists, but two resident scratches, so the budget sits between _MAX_CELLS
 # and _MAX_CELLS_LEAN.
 _MAX_CELLS_STRIP = 192 * 1024
-_HALO = 4                     # halo rows above/below a strip (keeps blocks
-                              # sublane-aligned; extra rows only help flow)
+_HALO = 8                     # halo rows above/below a strip: 8 keeps every
+                              # DMA row offset (s*strip and s*strip+_HALO)
+                              # provably sublane-aligned for Mosaic; the
+                              # extra halo rows only help propagation
 
 
 def _pack_geometry(nrows: int, ncols: int, lane_width: int,
@@ -303,10 +305,10 @@ def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
     lrow = lax.broadcasted_iota(jnp.int32, shape, 0)
     col = lax.broadcasted_iota(jnp.int32, shape, 1)
     core = (lrow >= _HALO) & (lrow < _HALO + strip_rows)
-    vmax = smax_ref[0, n_strips]
+    vmax = smax_ref[pid, n_strips]
 
     def load_strip(s, *, want_img: bool):
-        r0 = s * strip_rows
+        r0 = pl.multiple_of(s * strip_rows, 8)
         cp_l = pltpu.make_async_copy(
             lab_hbm.at[pl.ds(r0, rb), :], lab_vmem, sems.at[0])
         cp_l.start()
@@ -329,7 +331,9 @@ def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
 
     def init_body(s, _):
         cp = pltpu.make_async_copy(
-            lab_vmem, lab_hbm.at[pl.ds(s * strip_rows, rb), :], sems.at[0])
+            lab_vmem,
+            lab_hbm.at[pl.ds(pl.multiple_of(s * strip_rows, 8), rb), :],
+            sems.at[0])
         cp.start()
         cp.wait()
         return _
@@ -374,7 +378,9 @@ def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
                 lab_vmem[:] = lab_fin
                 cp = pltpu.make_async_copy(
                     lab_vmem.at[pl.ds(_HALO, strip_rows), :],
-                    lab_hbm.at[pl.ds(s * strip_rows + _HALO, strip_rows), :],
+                    lab_hbm.at[pl.ds(
+                        pl.multiple_of(s * strip_rows + _HALO, 8),
+                        strip_rows), :],
                     sems.at[0])
                 cp.start()
                 cp.wait()
@@ -388,7 +394,7 @@ def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
                 # alternate top-down / bottom-up passes so flows in either
                 # direction cascade across all boundaries within one pass
                 s = jnp.where(p % 2 == 0, i, n_strips - 1 - i)
-                nonempty = smax_ref[0, s] > thr
+                nonempty = smax_ref[pid, s] > thr
                 ch = lax.cond(nonempty, visit, lambda _s: jnp.array(False), s)
                 return jnp.logical_or(any_changed, ch)
 
@@ -408,12 +414,12 @@ def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
                 lab = jnp.where(mask, jnp.minimum(lab_vmem[:], gi), _BIG)
                 return jnp.sum((core & mask & (lab == gi)).astype(jnp.int32))
 
-            return lvl_acc + lax.cond(smax_ref[0, s] > thr, counted,
+            return lvl_acc + lax.cond(smax_ref[pid, s] > thr, counted,
                                       lambda _s: jnp.int32(0), s)
 
         return acc + lax.fori_loop(0, n_strips, count_body, jnp.int32(0))
 
-    out_ref[0, 0] = lax.fori_loop(0, nlevels, level_body, jnp.int32(0))
+    out_ref[pid, 0] = lax.fori_loop(0, nlevels, level_body, jnp.int32(0))
 
 
 def _strip_geometry(nrows: int, ncols: int,
@@ -426,7 +432,8 @@ def _strip_geometry(nrows: int, ncols: int,
     strip = (_MAX_CELLS_STRIP // cp - 2 * _HALO) // 8 * 8
     if strip_rows is not None:
         strip = strip_rows
-    if strip < 8 or strip % 8:
+    if (strip < 8 or strip % 8
+            or (strip + 2 * _HALO) * cp > _MAX_CELLS_STRIP):
         raise ValueError(
             f"no valid strip height for the strip chaos kernel: {ncols} "
             f"cols (padded {cp}) with strip_rows={strip} against the "
@@ -475,20 +482,32 @@ def chaos_count_sums_strips(
     vmax = smax.max(axis=1, keepdims=True)                     # (N, 1)
     smax_v = jnp.concatenate([smax, vmax], axis=1)             # (N, S+1)
 
-    counts = pl.pallas_call(
+    counts, _labels = pl.pallas_call(
         functools.partial(_chaos_strip_kernel, ncols=cp, nrows_pad=rp,
                           strip_rows=strip, nlevels=nlevels,
                           work_span=work_span),
-        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        # the label plane is an OUTPUT in compiler-managed (HBM) memory,
+        # not a scratch: Mosaic only allocates vmem/smem/semaphore scratch.
+        # It is shared by all (sequential) grid steps — each program
+        # re-inits it — and its final value is discarded.
+        out_shape=(jax.ShapeDtypeStruct((n, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((rp + 2 * _HALO, cp), jnp.int32)),
         grid=(n,),
         in_specs=[
-            pl.BlockSpec((1, n_strips + 1), lambda i: (i, 0),
+            # whole-array SMEM block (scalars): TPU lowering forbids partial
+            # blocks that aren't 8x128-aligned, so index by program id
+            pl.BlockSpec((n, n_strips + 1), lambda i: (0, 0),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        out_specs=(
+            # whole-array SMEM out block (scalar per program) for the same
+            # TPU alignment reason; each program writes its own row
+            pl.BlockSpec((n, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ),
         scratch_shapes=[
-            pltpu.HBM((rp + 2 * _HALO, cp), jnp.int32),
             pltpu.VMEM((strip + 2 * _HALO, cp), jnp.float32),
             pltpu.VMEM((strip + 2 * _HALO, cp), jnp.int32),
             pltpu.SemaphoreType.DMA((2,)),

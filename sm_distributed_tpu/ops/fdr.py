@@ -169,6 +169,9 @@ class FDR:
             return pd.DataFrame(
                 columns=["sf", "adduct", "msm", "fdr", "fdr_level"])
         out = pd.concat(frames, ignore_index=True)
+        # "sf" as the final key makes the row order a TOTAL order: without
+        # it, exact-MSM ties kept the incoming table order, which depends
+        # on the internal parallel.order_ions batching knob
         return out.sort_values(
-            ["adduct", "msm"], ascending=[True, False]
+            ["adduct", "msm", "sf"], ascending=[True, False, True]
         ).reset_index(drop=True)

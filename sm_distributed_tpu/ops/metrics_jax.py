@@ -87,10 +87,13 @@ def measure_of_chaos_batch(
     thresholds vmax * i/nlevels for i in 0..nlevels-1, 4-connectivity,
     chaos = max(0, 1 - mean(component counts)/n_nonzero), 0 for empty.
 
-    On TPU the per-level component counts come from the VMEM-resident Pallas
-    kernel (ops/chaos_pallas.py, ~8x the associative-scan path); elsewhere
-    (CPU test meshes, interpreters) the scan path below is used.  Both are
-    exact, so the dispatch cannot change results.
+    Three routes, all exact (the dispatch cannot change results): on TPU,
+    'packed' (whole image(s) VMEM-resident, ops/chaos_pallas.py) for
+    in-budget shapes or 'strips' (HBM-resident labels, halo'd row strips
+    through VMEM) past the lean budget; elsewhere — and for shapes even
+    strips cannot fit — the associative-scan path below.
+    ``use_pallas=True`` forces a pallas route and raises ValueError when
+    no pallas route fits the shape; ``False`` forces the scan path.
     """
     from .chaos_pallas import chaos_route
 
