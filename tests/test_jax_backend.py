@@ -467,6 +467,72 @@ def test_order_table_by_mz_results_invariant(fixture_ds):
     pd.testing.assert_frame_equal(a, b)
 
 
+def test_maybe_order_table_gate(fixture_ds):
+    """The auto gate orders at >=6 batches and keeps table order below;
+    'mz'/'table' force; bad values are rejected at config load."""
+    from sm_distributed_tpu.models.msm_basic import (
+        maybe_order_table, order_table_by_mz,
+    )
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    _, truth = fixture_ds
+    calc = IsocalcWrapper(IsotopeGenerationConfig(adducts=("+H",)))
+    table = calc.pattern_table([(sf, "+H") for sf in truth.formulas[:12]])
+    ordered = order_table_by_mz(table)
+    assert list(ordered.mzs[:, 0]) == sorted(table.mzs[:, 0])
+
+    def same(a, b):
+        return a.sfs == b.sfs and np.array_equal(a.mzs, b.mzs)
+
+    # 12 ions: batch=2 -> 6 batches (orders); batch=4 -> 3 batches (keeps)
+    assert same(maybe_order_table(table, "auto", 2), ordered)
+    assert same(maybe_order_table(table, "auto", 4), table)
+    assert same(maybe_order_table(table, "mz", 1000), ordered)
+    assert same(maybe_order_table(table, "table", 1), table)
+    with pytest.raises(ValueError, match="order_ions"):
+        SMConfig.from_dict({"parallel": {"order_ions": "off"}})
+    with pytest.raises(ValueError, match="band_slice"):
+        SMConfig.from_dict({"parallel": {"band_slice": "nope"}})
+
+
+def test_variant_estimator(fixture_ds):
+    """_variant_for picks by padded-capacity cost: narrow bands -> band,
+    tiny keeps with wide bands -> compact, near-full batches -> plain;
+    'on' modes force their variant."""
+    from sm_distributed_tpu.models.msm_jax import JaxBackend
+
+    ds, truth = fixture_ds
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+
+    def mk(band="auto", compaction="auto"):
+        sm = SMConfig.from_dict(
+            {"backend": "jax_tpu",
+             "parallel": {"formula_batch": 8, "band_slice": band,
+                          "peak_compaction": compaction}})
+        return JaxBackend(ds, ds_config, sm)
+
+    be = mk()
+    n = int(be._mz_host.size)
+    runs_tiny = (None, None, 1000, None)       # keep ~1k -> 64k capacity
+    band_narrow = (0, 100)                     # bucket = _BAND_MIN
+    band_wide = (0, n)                         # bucket >= n -> no band est
+    if be._BAND_MIN < n:
+        assert be._variant_for(None, band_narrow) == "band"
+    assert be._variant_for(None, band_wide) == "plain"
+    # compact charged at padded 64k-rounded capacity (37 ns/slot): wins
+    # over plain only when 37*cap < 14*n
+    want = "compact" if 37.0 * (1 << 16) < 14.0 * n else "plain"
+    assert be._variant_for(runs_tiny, None) == want
+    assert mk(band="on")._variant_for(None, band_wide) == "band"
+    assert mk(band="off", compaction="on")._variant_for(
+        runs_tiny, band_narrow) == "compact"
+    assert mk(band="off", compaction="off")._variant_for(
+        None, None) == "plain"
+
+
 def test_batch_peak_runs_plan_exact():
     """Host compaction plan: kept runs and re-based bound ranks agree with a
     brute-force recomputation on random windows over a random peak list."""
