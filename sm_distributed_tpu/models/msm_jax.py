@@ -474,19 +474,14 @@ class JaxBackend:
         return (grid, r_lo, r_hi, ints_p, nv_p, chunks, pos, runs, b_eff,
                 band)
 
-    # band-slice w_cap buckets are a {1, 1.5} x pow-2 ladder with a floor:
-    # each bucket is one (cached) executable; the 1.5x intermediate point
-    # bounds padded scatter waste at 33% (pure pow-2's 2x measured ~0.7
-    # s/rep of padding at DESI scale) while keeping the compile count
-    # logarithmic
+    # band-slice w_cap buckets: the shared {1, 1.5} x pow-2 ladder
+    # (ops/imager_jax.band_bucket — the sharded backend uses the same one)
     _BAND_MIN = 1 << 21
 
     def _band_bucket(self, width: int) -> int:
-        cap = self._BAND_MIN
-        while cap < width:
-            cap <<= 1
-        mid = (cap >> 2) * 3
-        return mid if cap > self._BAND_MIN and width <= mid else cap
+        from ..ops.imager_jax import band_bucket
+
+        return band_bucket(width, self._BAND_MIN)
 
     def _variant_for(self, runs, band) -> str:
         """Pick the extraction variant for one batch: 'band' (scatter a
@@ -495,7 +490,16 @@ class JaxBackend:
         everything).  Auto mode minimizes estimated scatter/gather cost
         with the measured v5e per-slot rates (docs/PERF.md: scatter ~14
         ns/slot, packed-run gather ~23 ns/slot -> compact ~37 ns per
-        capacity slot); 'on' modes force a variant for tests, band first."""
+        capacity slot); 'on' modes force a variant for tests, band first.
+
+        The compact estimate charges the sticky ``_n_keep`` capacity, so
+        the choice depends on the capacities in effect: presize/warmup/
+        score_batches grow them to a stream-wide FIXPOINT first
+        (_grow_for_stream), making decisions order-independent for a
+        planned stream.  Bare repeated ``score_batch`` calls (no presize)
+        still grow capacities batch by batch, so an identical batch seen
+        later in such a sequence can legitimately pick a different
+        variant (advisor r4)."""
         if self._band_mode == "on" and band is not None:
             return "band"
         if self._compaction == "on" and runs is not None:
@@ -695,8 +699,26 @@ class JaxBackend:
         this once with every slice before the group loop."""
         if self.mz_chunk:
             return
-        for t in tables:
-            self._grow_from_plan(self._flat_plan(t))
+        self._grow_for_stream([self._flat_plan(t) for t in tables])
+
+    def _grow_for_stream(self, plans) -> None:
+        """Grow the sticky capacities over ``plans`` to a FIXPOINT.
+
+        One pass is order-dependent: growing ``_n_keep`` raises the compact
+        estimate, which can flip a later identical batch's variant choice —
+        and a batch warmed as one variant could then dispatch as another,
+        recompiling mid-stream (advisor r4).  Capacities are monotone and
+        bounded, so repeating the pass until nothing grows terminates (2
+        passes in practice) and leaves every decision consistent with the
+        final capacities — dispatch re-evaluates against exactly these."""
+        while True:
+            before = (self._gc_width, self._gc_tail, self._n_keep,
+                      self._r_pad)
+            for plan in plans:
+                self._grow_from_plan(plan)
+            if before == (self._gc_width, self._gc_tail, self._n_keep,
+                          self._r_pad):
+                return
 
     def _grow_from_plan(self, plan) -> None:
         if plan[8] == self.batch:
@@ -717,8 +739,7 @@ class JaxBackend:
                 self.score_batch(tables[0])
             return
         plans = [self._flat_plan(t) for t in tables]
-        for plan in plans:
-            self._grow_from_plan(plan)
+        self._grow_for_stream(plans)
         reps, seen = [], set()
         for t, plan in zip(tables, plans):
             variant = self._variant_for(plan[7], plan[9])
@@ -743,7 +764,6 @@ class JaxBackend:
         # every batch (a mid-stream growth would recompile, ~15 s through a
         # tunneled TPU), and each plan is reused by its dispatch
         plans = [self._flat_plan(t) for t in tables]
-        for plan in plans:
-            self._grow_from_plan(plan)
+        self._grow_for_stream(plans)
         return fetch_scored_batches(
             [self._dispatch(t, plan) for t, plan in zip(tables, plans)])

@@ -43,6 +43,7 @@ from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
 )
 from ..ops.imager_jax import (
+    batch_peak_band,
     batch_peak_runs,
     compact_peaks,
     extract_images_flat_banded,
@@ -87,17 +88,25 @@ def build_sharded_score_factory(
 
     def step(px_s, in_s, pos, starts, r_lo_loc, r_hi_loc, inv,
              theor_ints, n_valid, run_pos, run_delta, n_b,
-             *, gc_width, n_keep):
+             *, gc_width, n_keep, w_cap):
         # Per-device blocks: px_s/in_s (1, Nmax); pos (1, G_loc); plan
         # (C, Wc)/(C,)/(W_loc,); theor (B_loc, K); n_valid (B_loc,);
         # compaction runs (1, R_pad)/(1, R_pad)/(1, 1) per (pixel-shard x
-        # formula-shard) — n_keep == 0 selects the plain path (single
-        # executable per (gc_width, n_keep) pair, mirroring JaxBackend).
+        # formula-shard).  Exactly one of n_keep/w_cap is nonzero: n_keep
+        # selects the compaction path, w_cap the band-slice path (scatter a
+        # contiguous dynamic slice of this shard's sorted peaks — the cell's
+        # window-union rank band; run_pos doubles as the (1, 1) per-cell
+        # band start), 0/0 the plain path.  One executable per
+        # (gc_width, n_keep, w_cap) triple, mirroring JaxBackend._VARIANTS.
         b, k = theor_ints.shape
         if n_keep:
             px_loc, in_loc = compact_peaks(
                 px_s[0], in_s[0], run_pos[0], run_delta[0], n_b[0, 0],
                 n_keep=n_keep, n_pixels=p_loc)
+        elif w_cap:
+            w_start = run_pos[0, 0]
+            px_loc = jax.lax.dynamic_slice(px_s[0], (w_start,), (w_cap,))
+            in_loc = jax.lax.dynamic_slice(in_s[0], (w_start,), (w_cap,))
         else:
             px_loc, in_loc = px_s[0], in_s[0]
         imgs_loc = extract_images_flat_banded(
@@ -123,11 +132,11 @@ def build_sharded_score_factory(
         # order, matching the original ion order)
         return jax.lax.all_gather(out_mine, PIXELS_AXIS, axis=0, tiled=True)
 
-    def make(gc_width, n_keep=0):
+    def make(gc_width, n_keep=0, w_cap=0):
         from functools import partial
 
         sharded = jax.shard_map(
-            partial(step, gc_width=gc_width, n_keep=n_keep),
+            partial(step, gc_width=gc_width, n_keep=n_keep, w_cap=w_cap),
             mesh=mesh,
             in_specs=(
                 P(PIXELS_AXIS, None),             # px_s (S, Nmax)
@@ -216,10 +225,8 @@ class ShardedJaxBackend:
         if restrict_table is not None:
             mz_s, px_s, in_s = self._restrict_shards(
                 mz_s, px_s, in_s, restrict_table)
-        from ..ops.quantize import MZ_PAD_Q
-
         self._compaction = sm_config.parallel.peak_compaction
-        self._max_row = max(1, int((mz_s != MZ_PAD_Q).sum(axis=1).max()))
+        self._band_mode = sm_config.parallel.band_slice
         self._n_keep = 0          # sticky compacted capacity (see JaxBackend)
         self._r_pad = 0           # sticky run-list capacity
         self.int_scale = ds.intensity_quantization(self.ppm)[1]
@@ -286,6 +293,7 @@ class ShardedJaxBackend:
         b_loc = b // f
         poss, starts_l, rlo_l, rhi_l, invs, gc = [], [], [], [], [], 0
         runs_sf: list[list] = [[] for _ in range(n_px)]  # [s][f] run plans
+        bands_sf: list[list] = [[] for _ in range(n_px)]  # [s][f] rank bands
         for fi, (sl, grid, rl, rh, pos_rows) in enumerate(
                 self._shard_grids(lo_p, hi_p)):
             st, rll, rhl, inv, gcs = window_chunks(rl, rh, _BAND_WINDOWS)
@@ -299,11 +307,21 @@ class ShardedJaxBackend:
                     runs_sf[px].append(batch_peak_runs(
                         self._mz_shards[px], lo_p[sl], hi_p[sl],
                         pos_rows[px]))
+            if self._band_mode != "off":
+                # each (pixel-shard, formula-shard) cell's contiguous rank
+                # band of the shard's sorted peaks under THIS formula
+                # shard's window union — with an m/z-ordered table the
+                # formula shards are m/z sub-ranges of the batch, so cells
+                # are even narrower than the whole batch's band
+                for px in range(n_px):
+                    bands_sf[px].append(batch_peak_band(
+                        self._mz_shards[px], lo_p[sl], hi_p[sl]))
             poss.append(np.stack(pos_rows))
         runs = runs_sf if self._compaction != "off" else None
+        bands = bands_sf if self._band_mode != "off" else None
         return (np.concatenate(poss, axis=1), np.concatenate(starts_l),
                 np.concatenate(rlo_l), np.concatenate(rhi_l),
-                np.concatenate(invs), ints_p, nv_p, gc, runs)
+                np.concatenate(invs), ints_p, nv_p, gc, runs, bands)
 
     def _shard_grids(self, lo_p: np.ndarray, hi_p: np.ndarray):
         """Per formula shard: (row slice, bound grid, r_lo, r_hi, and each
@@ -320,17 +338,40 @@ class ShardedJaxBackend:
                         for px in range(n_px)]
             yield sl, grid, rl, rh, pos_rows
 
-    def _use_compaction(self, runs) -> bool:
-        """Per-batch mesh-wide decision (all devices must run one program):
-        compact when the busiest (pixel-shard, formula-shard) cell keeps a
-        minority of the busiest shard's peaks — the same 0.7 rule as the
-        single-device backend, on per-device work."""
-        if runs is None or self._compaction == "off":
-            return False
-        if self._compaction == "on":
-            return True
-        max_keep = max(r[2] for row in runs for r in row)
-        return max_keep <= 0.7 * self._max_row
+    def _variant_for(self, runs, bands) -> str:
+        """Per-batch MESH-WIDE extraction variant (all devices run one
+        program, so the decision keys on the busiest cell): 'band', 'compact'
+        or 'plain' — the same measured-rate estimator as
+        JaxBackend._variant_for (scatter ~14 ns/slot, packed-run gather ~23
+        ns -> compact ~37 ns per capacity slot), on per-device work.  'on'
+        modes force a variant for tests, band first.  Capacities are grown
+        to a stream fixpoint first (_grow_static_shapes), so decisions are
+        order-independent for a planned stream."""
+        if self._band_mode == "on" and bands is not None:
+            return "band"
+        if self._compaction == "on" and runs is not None:
+            return "compact"
+        n = int(self._px_s.shape[1])
+        est = {"plain": 14.0 * n}
+        if runs is not None and self._compaction != "off":
+            max_keep = max((r[2] for row in runs for r in row), default=1)
+            cap_c = max(-(-max(max_keep, 1) // (1 << 16)) * (1 << 16),
+                        self._n_keep)
+            est["compact"] = 37.0 * min(cap_c, n)
+        if bands is not None and self._band_mode != "off":
+            cap = self._band_cap(bands)
+            if cap < n:
+                est["band"] = 14.0 * cap
+        return min(est, key=est.get)
+
+    def _band_cap(self, bands) -> int:
+        """Static band-slice width for one batch: the bucketed max cell
+        width (every cell slices the same static width; narrower cells'
+        extra slice peaks land in gap bins with zero membership — exact)."""
+        from ..ops.imager_jax import band_bucket
+
+        w = max((b[1] for row in bands for b in row), default=0)
+        return min(band_bucket(w), int(self._px_s.shape[1]))
 
     def _grow_compact_capacity(self, runs) -> None:
         # capacity clamps at the per-shard resident row length: padding
@@ -364,28 +405,56 @@ class ShardedJaxBackend:
             posb.append(np.concatenate(row_pos))
         return rp, rd, nb, np.stack(posb)
 
+    def _pack_bands(self, bands, pos, w_cap):
+        """(w_start (S, F) i32, pos_b (S, F*G_loc) band-space bound ranks).
+
+        Mirrors JaxBackend's band dispatch: each cell's start is clamped so
+        the static-width slice stays inside the shard row; bounds outside
+        the slice clip to 0/w_cap, exactly how the full plain path treats
+        peaks before/after the band (see
+        models/msm_jax.py::fused_score_fn_flat_banded_sliced)."""
+        n_px, f = len(bands), len(bands[0])
+        n = int(self._px_s.shape[1])
+        g_loc = pos.shape[1] // f
+        ws = np.zeros((n_px, f), np.int32)
+        pos_b = np.empty_like(pos)
+        for s in range(n_px):
+            for fi in range(f):
+                b_lo, _w = bands[s][fi]
+                start = max(0, min(b_lo, n - w_cap))
+                ws[s, fi] = start
+                sl = slice(fi * g_loc, (fi + 1) * g_loc)
+                pos_b[s, sl] = np.clip(pos[s, sl] - start, 0, w_cap)
+        return ws, pos_b.astype(np.int32)
+
     def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
         """Async: enqueue one padded sharded batch, return (device_out, n)."""
         if flat_plan is None:
             flat_plan = self._flat_plan(table)
-        pos, starts, rlo, rhi, inv, ints_p, nv_p, gc, runs = flat_plan
+        pos, starts, rlo, rhi, inv, ints_p, nv_p, gc, runs, bands = flat_plan
         self._gc_width = max(self._gc_width, gc)
         gc = self._gc_width
         n_px = self._mz_shards.shape[0]
         f = self._n_form_shards
-        if self._use_compaction(runs):
+        variant = self._variant_for(runs, bands)
+        n_keep = w_cap = 0
+        if variant == "compact":
             self._grow_compact_capacity(runs)
             n_keep = self._n_keep
             rp, rd, nb, posb = self._pack_runs(runs)
             pos = posb                 # kept-space bound ranks
+        elif variant == "band":
+            w_cap = self._band_cap(bands)
+            rp, pos = self._pack_bands(bands, pos, w_cap)  # rp = band starts
+            rd = np.zeros((n_px, f), np.int32)
+            nb = np.zeros((n_px, f), np.int32)
         else:
-            n_keep = 0
             rp = np.zeros((n_px, f), np.int32)   # unused dummies, (1,1) blocks
             rd = np.zeros((n_px, f), np.int32)
             nb = np.zeros((n_px, f), np.int32)
-        key = (gc, n_keep)
+        key = (gc, n_keep, w_cap)
         if key not in self._fns:
-            self._fns[key] = self._make_fn(gc, n_keep)
+            self._fns[key] = self._make_fn(gc, n_keep, w_cap)
         pos_d = jax.device_put(pos, self._pos_sharding)
         starts_d = jax.device_put(starts, self._nv_sharding)
         rlo_d = jax.device_put(rlo, self._form_sharding)
@@ -483,10 +552,17 @@ class ShardedJaxBackend:
             [self._dispatch(t, plan) for t, plan in zip(tables, plans)])
 
     def _grow_static_shapes(self, plans) -> None:
-        for plan in plans:
-            self._gc_width = max(self._gc_width, plan[7])
-            if self._use_compaction(plan[8]):
-                self._grow_compact_capacity(plan[8])
+        # fixpoint, like JaxBackend._grow_for_stream: growing the compact
+        # capacity can flip a batch's variant, so repeat until stable
+        # (monotone + bounded -> terminates; 2 passes in practice)
+        while True:
+            before = (self._gc_width, self._n_keep, self._r_pad)
+            for plan in plans:
+                self._gc_width = max(self._gc_width, plan[7])
+                if self._variant_for(plan[8], plan[9]) == "compact":
+                    self._grow_compact_capacity(plan[8])
+            if before == (self._gc_width, self._n_keep, self._r_pad):
+                return
 
     def presize(self, tables) -> None:
         """Grow the sticky static shapes to cover ``tables`` without scoring
@@ -503,16 +579,17 @@ class ShardedJaxBackend:
         tables = list(tables)
         plans = [self._flat_plan(t) for t in tables]
         self._grow_static_shapes(plans)
-        seen: set[bool] = set()
+        seen: set[tuple] = set()
         for t, plan in zip(tables, plans):
-            kind = self._use_compaction(plan[8])
+            variant = self._variant_for(plan[8], plan[9])
+            # each band w_cap bucket is its own executable
+            bucket = self._band_cap(plan[9]) if variant == "band" else 0
+            kind = (variant, bucket)
             if kind not in seen:
                 seen.add(kind)
                 # reuse the precomputed plan — _flat_plan is the expensive
                 # host pass (per-cell searchsorted over the shard peaks)
                 to_numpy_global(self._dispatch(t, plan)[0])
-            if len(seen) == 2:
-                break
 
 
 def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig,
