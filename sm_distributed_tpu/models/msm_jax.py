@@ -31,6 +31,8 @@ from ..ops.imager_jax import (
     extract_images_flat_banded,
     extract_images_mz_chunked,
     flat_bound_ranks,
+    ion_window_chunks,
+    ions_per_chunk_for,
     prepare_cube_arrays,
     prepare_flat_sorted_arrays,
     window_chunks,
@@ -39,13 +41,29 @@ from ..ops.imager_jax import (
 from ..ops.isocalc import IsotopePatternTable
 from ..ops.metrics_jax import (
     batch_metrics,
-    isotope_image_correlation_batch,
+    correlation_from_moments,
     isotope_pattern_match_batch,
     measure_of_chaos_batch,
 )
 from ..ops.quantize import quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
+
+
+def _maybe_barrier(imgs: jnp.ndarray, k: int, n_pix: int) -> jnp.ndarray:
+    """Materialize the image block before the metric consumers ONLY when
+    the metrics run as XLA reductions: there, XLA fusing the extraction
+    into the three consumers regressed the step ~3.4x at 65k pixels
+    (docs/PERF.md mechanism 3).  On the TPU Pallas metrics route
+    (ops/moments_pallas.py + chaos kernels) the consumers are opaque
+    kernel calls — the input is materialized once by definition and the
+    extra barrier copy is a pure full-block pass wasted (~2.1 GB per
+    DESI batch)."""
+    from ..ops.moments_pallas import moments_fit
+
+    if jax.default_backend() == "tpu" and moments_fit(k, n_pix):
+        return imgs
+    return jax.lax.optimization_barrier(imgs)
 
 
 def fused_score_fn_flat_banded(
@@ -70,18 +88,22 @@ def fused_score_fn_flat_banded(
 ) -> jnp.ndarray:
     """Fused flat-path scoring: banded-matmul extraction (flops linear in
     the batch, so large batches amortize the histogram scatter — see
-    ops/imager_jax.py::extract_images_flat_banded) + MSM metrics."""
+    ops/imager_jax.py::extract_images_flat_banded) + MSM metrics.
+
+    The chunk plan is ION-MAJOR (ion_window_chunks): extraction emits the
+    (b, k, P) block directly — no multi-GB image-row gather; ``inv`` is
+    the (b,) ion inverse permutation applied to the (b, 4) METRIC rows,
+    and theor_ints / n_valid arrive already ion-sorted."""
     imgs = extract_images_flat_banded(
-        pixel_sorted, int_sorted, pos, starts, r_lo_loc, r_hi_loc, inv,
+        pixel_sorted, int_sorted, pos, starts, r_lo_loc, r_hi_loc, None,
         gc_width=gc_width, n_pixels=nrows * ncols)
-    # see fused_score_fn_flat_banded_compact: stop XLA from fusing the
-    # extraction into the metric consumers (measured 3x regression at 65k px)
-    imgs = jax.lax.optimization_barrier(imgs)
+    imgs = _maybe_barrier(imgs, k, nrows * ncols)
     imgs = imgs.reshape(b, k, -1)
-    return batch_metrics(
+    out = batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
         do_preprocessing=do_preprocessing, q=q,
     )
+    return jnp.take(out, inv, axis=0)
 
 
 def _extract_sliced(
@@ -89,11 +111,13 @@ def _extract_sliced(
     starts, r_lo_loc, r_hi_loc, inv, *, w_cap, gc_width, n_pixels,
 ):
     """Band slice + banded extraction (the first half of
-    fused_score_fn_flat_banded_sliced) as a standalone probe phase."""
+    fused_score_fn_flat_banded_sliced) as a standalone probe phase.
+    ``inv`` (the ion un-permutation) is unused here — probe consumers work
+    in the plan's ion-sorted order with matching permuted side inputs."""
     px_b = jax.lax.dynamic_slice(pixel_sorted, (w_start,), (w_cap,))
     in_b = jax.lax.dynamic_slice(int_sorted, (w_start,), (w_cap,))
     return extract_images_flat_banded(
-        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, inv,
+        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, None,
         gc_width=gc_width, n_pixels=n_pixels)
 
 
@@ -129,20 +153,21 @@ def fused_score_fn_flat_banded_sliced(
     gap bins with zero band membership, and ``pos_b`` is host-shifted with
     padding bounds clipped to 0 — both exactly mirror how the full plain
     path treats peaks before/after/between windows, so images (and hence
-    metrics) are bit-identical to the uncompacted path."""
+    metrics) are bit-identical to the uncompacted path.  Ion-major chunk
+    plan: see fused_score_fn_flat_banded (``inv`` un-permutes metric
+    rows)."""
     px_b = jax.lax.dynamic_slice(pixel_sorted, (w_start,), (w_cap,))
     in_b = jax.lax.dynamic_slice(int_sorted, (w_start,), (w_cap,))
     imgs = extract_images_flat_banded(
-        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, inv,
+        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, None,
         gc_width=gc_width, n_pixels=nrows * ncols)
-    # see fused_score_fn_flat_banded_compact: stop XLA from fusing the
-    # extraction into the metric consumers
-    imgs = jax.lax.optimization_barrier(imgs)
+    imgs = _maybe_barrier(imgs, k, nrows * ncols)
     imgs = imgs.reshape(b, k, -1)
-    return batch_metrics(
+    out = batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
         do_preprocessing=do_preprocessing, q=q,
     )
+    return jnp.take(out, inv, axis=0)
 
 
 def _extract_compact(
@@ -150,12 +175,13 @@ def _extract_compact(
     starts, r_lo_loc, r_hi_loc, inv, *, n_keep, gc_width, n_pixels,
 ):
     """Compaction + banded extraction (the first half of
-    fused_score_fn_flat_banded_compact) as a standalone probe phase."""
+    fused_score_fn_flat_banded_compact) as a standalone probe phase.
+    ``inv`` unused — see _extract_sliced."""
     px_b, in_b = compact_peaks(
         pixel_sorted, int_sorted, run_pos, run_delta, n_b,
         n_keep=n_keep, n_pixels=n_pixels)
     return extract_images_flat_banded(
-        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, inv,
+        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, None,
         gc_width=gc_width, n_pixels=n_pixels)
 
 
@@ -187,22 +213,22 @@ def fused_score_fn_flat_banded_compact(
     inside this batch's window union are gathered and histogrammed, so the
     scatter cost is per-hit, not per-resident-peak (the dominant cost in the
     many-batch large-pixel regime — see ops/imager_jax.py compaction notes).
-    Images, and hence metrics, are bit-identical to the uncompacted path."""
+    Images, and hence metrics, are bit-identical to the uncompacted path.
+    Ion-major chunk plan: see fused_score_fn_flat_banded (``inv``
+    un-permutes metric rows)."""
     px_b, in_b = compact_peaks(
         pixel_sorted, int_sorted, run_pos, run_delta, n_b,
         n_keep=n_keep, n_pixels=nrows * ncols)
     imgs = extract_images_flat_banded(
-        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, inv,
+        px_b, in_b, pos_b, starts, r_lo_loc, r_hi_loc, None,
         gc_width=gc_width, n_pixels=nrows * ncols)
-    # materialize the image block before the metric consumers: without the
-    # barrier XLA's fusion across extraction->metrics regressed the step
-    # ~3x at 65k pixels (measured: 3.4 s fused vs ~1.1 s sum-of-parts)
-    imgs = jax.lax.optimization_barrier(imgs)
+    imgs = _maybe_barrier(imgs, k, nrows * ncols)
     imgs = imgs.reshape(b, k, -1)
-    return batch_metrics(
+    out = batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
         do_preprocessing=do_preprocessing, q=q,
     )
+    return jnp.take(out, inv, axis=0)
 
 
 def fused_score_fn_chunked(
@@ -372,7 +398,10 @@ class JaxBackend:
             # guard: the histogram scratch is (P+1, 2BK+gc) f32 — beyond a
             # few GB the device OOM is opaque, so fail early with guidance
             k_est = ds_config.isotope_generation.n_peaks
-            scratch = 4 * (ds.n_pixels + 1) * (2 * self.batch * k_est + 4096)
+            # scratch cols = max(G+1, gc+2): bins live in [0, G=2BK]; chunk
+            # slices clamp+shift instead of spilling past G (imager_jax)
+            scratch = 4 * (ds.n_pixels + 1) * max(
+                2 * self.batch * k_est + 1, 4098)
             if scratch > (8 << 30):
                 raise ValueError(
                     f"flat-path histogram scratch would be ~{scratch / 2**30:.0f}"
@@ -462,7 +491,13 @@ class JaxBackend:
         shapes, then reuses them)."""
         b_eff = self._batch_for(table.n_ions)
         grid, r_lo, r_hi, ints_p, nv_p = self._padded_windows(table, b_eff)
-        chunks = window_chunks(r_lo, r_hi, _BAND_WINDOWS)
+        # ion-major plan: whole ions per chunk (largest divisor of the
+        # static batch within the BAND_WINDOWS budget), so extraction
+        # emits (b, k, P) directly and only metric rows get un-permuted
+        k_eff = max(1, table.max_peaks)
+        chunks = ion_window_chunks(
+            r_lo, r_hi, b_eff, k_eff,
+            ions_per_chunk_for(b_eff, k_eff, _BAND_WINDOWS))
         pos = flat_bound_ranks(self._mz_host, grid)
         runs, band = None, None
         if self._compaction != "off" or self._band_mode != "off":
@@ -539,7 +574,11 @@ class JaxBackend:
             flat_plan = self._flat_plan(table)
         (_grid, _r_lo, _r_hi, ints_p, nv_p, chunks, pos, runs,
          b_eff, band) = flat_plan
-        starts, r_lo_loc, r_hi_loc, inv, gc_width = chunks
+        starts, r_lo_loc, r_hi_loc, inv, gc_width, order = chunks
+        # per-ion side inputs follow the plan's ion sort; the fused fn
+        # un-permutes the metric rows with ``inv``
+        ints_p = ints_p[order]
+        nv_p = nv_p[order]
         # the tail executable keeps its own sticky band width: sharing
         # the full-size band would blow the small batch's matmul cost
         if b_eff == self.batch:
@@ -622,8 +661,11 @@ class JaxBackend:
                        if kk in ("n_keep", "w_cap", "gc_width")}
         ext_fn = jax.jit(partial(
             ext_base, n_pixels=self.ds.n_pixels, **ext_statics))
-        # extraction args = everything before (theor_ints, n_valid)
-        ext_args = args[:n_ext]
+        # extraction args = everything before (theor_ints, n_valid); the
+        # trailing ``inv`` is the ION un-permutation consumed by the fused
+        # fn's metric output, not by extraction — probes keep the plan's
+        # ion-sorted order (side inputs below are permuted to match)
+        ext_args = list(args[: n_ext - 1]) + [None]
         phases["extract"] = lambda: ext_fn(
             self._px_s, self._in_s, *ext_args)
         imgs = phases["extract"]().reshape(
@@ -631,15 +673,26 @@ class JaxBackend:
         nv_p, ints_p = args[-1], args[-2]
         valid_d = jax.device_put(
             np.arange(statics["k"])[None, :] < np.asarray(nv_p)[:, None])
+        # the metric probes mirror the PRODUCTION route exactly
+        # (batch_metrics): one fused moments pass feeds chaos thresholds
+        # and the correlation/pattern epilogues — timing the old separate
+        # XLA reductions here would attribute phantom cost the fused
+        # graph no longer pays (advisor r5)
+        from ..ops.moments_pallas import batch_moments
+
+        mom_fn = jax.jit(batch_moments)
+        phases["moments"] = lambda: mom_fn(imgs)
+        _sums, _normsq, _dots, _vmax, _nn = mom_fn(imgs)
         chaos_fn = jax.jit(partial(
             measure_of_chaos_batch, nrows=self.ds.nrows, ncols=self.ds.ncols,
             nlevels=img_cfg.nlevels))
-        phases["chaos"] = lambda: chaos_fn(imgs[:, 0, :])
-        corr_fn = jax.jit(isotope_image_correlation_batch)
-        phases["correlation"] = lambda: corr_fn(imgs, ints_p, valid_d)
-        pat_fn = jax.jit(lambda im, th, v: isotope_pattern_match_batch(
-            im.sum(-1), th, v))
-        phases["pattern"] = lambda: pat_fn(imgs, ints_p, valid_d)
+        phases["chaos"] = lambda: chaos_fn(
+            imgs[:, 0, :], vmax=_vmax, n_notnull=_nn)
+        corr_fn = jax.jit(correlation_from_moments)
+        phases["correlation"] = lambda: corr_fn(
+            _normsq, _dots, ints_p, valid_d)
+        pat_fn = jax.jit(isotope_pattern_match_batch)
+        phases["pattern"] = lambda: pat_fn(_sums, ints_p, valid_d)
         info = dict(path="flat", variant=variant, **statics,
                     resident_peaks=int(self._px_s.shape[0]),
                     grid_bins=int(args[pos_ix].shape[0]))

@@ -222,23 +222,48 @@ def extract_images_flat_banded(
     g = pos.shape[0]
     delta = jnp.zeros(n + 1, jnp.int32).at[pos].add(1)
     bins = jnp.cumsum(delta[:-1])
-    # extra zero columns so the last chunk's band slice stays in range
-    # (dynamic_slice would otherwise clamp the start and misalign ranks)
-    wh = jnp.zeros((n_pixels + 1, g + 1 + gc_width + 2), jnp.float32).at[
-        pixel_sorted, bins].add(int_sorted)
-    whp = wh[:n_pixels]
+    # Scratch width: all bins live in [0, g], so max(g+1, gc+2) columns
+    # suffice — chunk slices near the top CLAMP their start and shift the
+    # local window ranks by the same delta (start + span <= g+1 <= cols
+    # guarantees shifted ranks stay inside the gc+2-wide band, see below).
+    # The scatter's FIXED cost is the operand zero-init/copy at ~38 GB/s
+    # (measured: ~12 ns/update marginal + ~28 ns/column/1k-rows fixed on
+    # v5e), so the old g+1+gc+2 layout paid ~2x the necessary fixed cost
+    # on every 256-ion DESI batch (G ~= gc there).  Bit-exact: each
+    # window still sums exactly its own bins' integers (any order — the
+    # quantized grid keeps every sum < 2**24).
+    cols = max(g + 1, gc_width + 2)
+    # TRANSPOSED scratch (bins-major): measured on v5e at DESI shapes,
+    # the (cols, P) layout scatters ~6% faster than (P, cols), its chunk
+    # slice is a row-range, and the membership matmul d.T @ band emits
+    # images already (W, P) — no per-chunk output transpose (together
+    # ~15 ms per 256-ion DESI batch)
+    wh = jnp.zeros((cols, n_pixels + 1), jnp.float32).at[
+        bins, pixel_sorted].add(int_sorted)
+    whp = wh[:, :n_pixels]
     gg = jnp.arange(gc_width + 2, dtype=jnp.int32)[:, None]
 
     def chunk(_, data):
         start, rlo, rhi = data
+        # clamp keeps the static-width slice inside the scratch; the
+        # chunk's windows span global cols [start, start+span] with
+        # start+span <= g+1 <= cols, so shift + span <= gc+2 always
+        start_eff = jnp.minimum(start, np.int32(cols - (gc_width + 2)))
+        shift = start - start_eff
         band = jax.lax.dynamic_slice(
-            whp, (jnp.int32(0), start), (n_pixels, gc_width + 2))
-        d = ((gg > rlo[None, :]) & (gg <= rhi[None, :])).astype(jnp.float32)
+            whp, (start_eff, jnp.int32(0)), (gc_width + 2, n_pixels))
+        d = ((gg > (rlo + shift)[None, :])
+             & (gg <= (rhi + shift)[None, :])).astype(jnp.float32)
         return None, jnp.dot(
-            band, d, precision=jax.lax.Precision.HIGHEST).T
+            d.T, band, precision=jax.lax.Precision.HIGHEST)
 
     _, imgs = jax.lax.scan(chunk, None, (starts, r_lo_loc, r_hi_loc))
     imgs = imgs.reshape(-1, n_pixels)                  # (C*Wc, P) sorted order
+    if inv is None:
+        # ion-major plans (ion_window_chunks): rows are already grouped
+        # by ion — the caller un-permutes the tiny metric rows instead of
+        # gathering the multi-GB image block
+        return imgs
     return jnp.take(imgs, inv, axis=0)                 # (W, P) input order
 
 
@@ -281,17 +306,46 @@ def prepare_flat_sharded_arrays(
     return mz_s, px_s, in_s, p_loc
 
 
+def gc_ladder(span: int) -> int:
+    """Static chunk band width for a window span: smallest {1, 1.5} x
+    pow-2 point >= span (shared by window_chunks and ion_window_chunks so
+    the driver entry and the backend can never disagree on the plan)."""
+    cap = 2
+    while cap < span:
+        cap <<= 1
+    mid = (cap >> 2) * 3
+    return mid if span <= mid and mid >= 2 else cap
+
+
+def ions_per_chunk_for(b: int, k: int, window_budget: int) -> int:
+    """Largest divisor of the static batch ``b`` whose k-window block
+    stays within ``window_budget`` windows per chunk (the shared rule for
+    ion-major chunk plans)."""
+    ipc = max(1, min(window_budget // max(k, 1), b))
+    while b % ipc:
+        ipc -= 1
+    return ipc
+
+
 def band_bucket(width: int, floor: int = 1 << 21) -> int:
     """Static band-slice capacity for a band of ``width`` peaks: the
-    smallest {1, 1.5} x pow-2 ladder point >= width (with a floor).  Each
-    bucket is one (cached) executable; the 1.5x intermediate point bounds
-    padded scatter waste at 33% (pure pow-2's 2x measured ~0.7 s/rep of
-    padding at DESI scale) while keeping the compile count logarithmic."""
+    smallest {1, 1.125..1.875 step 1/8} x pow-2 ladder point >= width
+    (with a floor).  Each bucket is one (cached) executable; eighth
+    points bound padded scatter waste at 12.5% (~6% expected — the r4
+    {1, 1.5} ladder's 50% bound measured ~440M scatter slots/rep at DESI
+    scale against ~318M actual band peaks; at ~12 ns per padded slot the
+    finer ladder buys ~1 s/rep for ~10 one-time cached compiles; a /16
+    ladder would only halve the residual ~6% while doubling the compile
+    count)."""
     cap = floor
     while cap < width:
         cap <<= 1
-    mid = (cap >> 2) * 3
-    return mid if cap > floor and width <= mid else cap
+    if cap > floor:
+        for eighths in range(9, 16):
+            mid = (cap >> 4) * eighths
+            if width <= mid:
+                return mid
+    return cap
 
 
 def batch_peak_band(mz_host: np.ndarray, lo_q: np.ndarray,
@@ -532,11 +586,65 @@ def window_chunks(
         r_hi_s[-1, wc - pad:] = starts[-1]
     r_lo_loc = (r_lo_s - starts[:, None]).astype(np.int32)
     r_hi_loc = (r_hi_s - starts[:, None]).astype(np.int32)
-    span = int(r_hi_loc.max()) if w else 1
-    gc_width = 1 << int(np.ceil(np.log2(max(span, 2 * wc, 2))))
+    # {1, 1.5} x pow-2 ladder (floor wc): gc is a STATIC matmul/slice width
+    # shared by every chunk, so rounding 1026 -> 2048 (the old pure-pow-2
+    # rule) paid ~33% extra membership-matmul flops and band-slice reads
+    # on typical 512-window chunks; the half-point bounds that at 50% while
+    # the sticky per-stream max keeps one executable per stream either way
+    gc_width = gc_ladder(max(int(r_hi_loc.max()) if w else 1, wc, 2))
     inv = np.empty(w, dtype=np.int32)
     inv[order] = np.arange(w, dtype=np.int32)
     return starts, r_lo_loc, r_hi_loc, inv, gc_width
+
+
+def ion_window_chunks(
+    r_lo: np.ndarray, r_hi: np.ndarray, b: int, k: int,
+    ions_per_chunk: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, np.ndarray]:
+    """ION-MAJOR chunk plan: (starts (C,), r_lo_loc (C, Wc), r_hi_loc
+    (C, Wc), inv_ions (b,), gc_width, order (b,)).
+
+    Like ``window_chunks`` but whole IONS are sorted (by their first real
+    window's lo rank; all-empty padding ions last) and chunked, all K
+    windows of an ion staying adjacent — so the banded matmul emits image
+    rows already ION-MAJOR: the (b, k, P) block needs NO (W, P) gather
+    (``jnp.take`` of a 1 GB block per DESI batch, ~2.1 GB of pure HBM
+    permutation traffic), only the final (b, 4) METRIC rows are
+    un-permuted by ``inv_ions``.  Callers permute the per-ion side inputs
+    (theor_ints, n_valid) by ``order`` to match.  Exact: each window
+    still sums exactly its own bins (integer grid, any order/grouping).
+
+    Requires ``ions_per_chunk`` to divide ``b`` (static batches are
+    powers of two; callers clamp).  gc_width uses the same {1, 1.5} x
+    pow-2 ladder as window_chunks."""
+    r_lo2 = np.asarray(r_lo).reshape(b, k)
+    r_hi2 = np.asarray(r_hi).reshape(b, k)
+    empty = r_lo2 >= r_hi2
+    all_empty = empty.all(axis=1)
+    first_real = np.argmax(~empty, axis=1)
+    first_lo = np.where(all_empty, 0, r_lo2[np.arange(b), first_real])
+    order = np.lexsort((first_lo, all_empty.astype(np.int8)))
+    ipc = ions_per_chunk
+    c = b // ipc
+    wc = ipc * k
+    r_lo_s = r_lo2[order].reshape(c, wc)
+    r_hi_s = r_hi2[order].reshape(c, wc)
+    real_s = ~empty[order].reshape(c, wc)
+    # chunk offset: min lo rank over the chunk's REAL windows (an all-
+    # padding chunk keeps 0); empty windows' local ranks may go negative,
+    # which the membership test already treats as empty
+    big = np.int64(1) << 40
+    lo_real = np.where(real_s, r_lo_s, big)
+    starts = np.where(real_s.any(axis=1), lo_real.min(axis=1), 0).astype(
+        np.int32)
+    r_lo_loc = (r_lo_s - starts[:, None]).astype(np.int32)
+    r_hi_loc = (r_hi_s - starts[:, None]).astype(np.int32)
+    span = int(np.where(real_s, r_hi_loc, 0).max()) if b else 1
+    gc_width = gc_ladder(max(span, wc, 2))
+    inv_ions = np.empty(b, dtype=np.int32)
+    inv_ions[order] = np.arange(b, dtype=np.int32)
+    return (starts, r_lo_loc, r_hi_loc, inv_ions, gc_width,
+            order.astype(np.int32))
 
 
 def extract_images_mz_chunked(

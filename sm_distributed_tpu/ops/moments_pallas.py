@@ -1,0 +1,128 @@
+"""Fused per-ion image moments: one HBM read for every metric reduction.
+
+The MSM metric stage needs, per (ion, peak) image row of the (N, K, P)
+block: the pixel sum (spectral pattern match + correlation means), the
+centered norm and centered dot against the principal row (spatial
+correlation), and per ion the principal row's max + positive count (chaos
+thresholds / alive gating).  As separate XLA reductions those are ~2.5
+passes over the block at the VPU reduce rate (~150 GB/s effective on a
+tunneled v5e) — ~25-30 ms per 1 GB DESI batch, pure HBM traffic.
+
+This Pallas kernel streams each ion's (K, P) row block through VMEM once
+(grid over ions, block (1, K, P)) and computes ALL of them in-kernel,
+reading the tile twice from VMEM (free) for the exact two-pass centered
+formulas — the one-pass raw-moment identity (sum(x^2) - P*mean^2) is NOT
+used: with integer-grid pixel values up to 2**24 it cancels
+catastrophically in f32.  Reduction ORDER differs from XLA's tree, so
+spatial/spectral values can move within the documented 1e-6 cross-backend
+contract (chaos integer counts are unaffected — thresholds come from the
+exact max).
+
+Reference semantics: ``img_measures.py::isotope_image_correlation /
+isotope_pattern_match [U]`` (SURVEY.md §3.4) — the math matches
+ops/metrics_np.py; this file only changes where the flops run.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# VMEM budget for one ion's (K, P) row block, in f32 cells.  The block is
+# sublane-padded to 8 rows (K=4 -> 2x), and the per-tile transients are
+# small, so 2M cells =~ 8 MB padded stays well inside the 16 MB scoped
+# limit alongside Mosaic's own buffers.
+_MAX_CELLS = 2 * 1024 * 1024
+# in-kernel VMEM tile width (lanes) for the two passes
+_TILE = 16384
+
+
+def moments_fit(k: int, n_pix: int) -> bool:
+    """True when one ion's (K, P) block fits the kernel's VMEM budget."""
+    return k * n_pix <= _MAX_CELLS and n_pix % 128 == 0
+
+
+def _moments_kernel(img_ref, out_ref, *, k: int, p: int):
+    nt = p // _TILE if p % _TILE == 0 else 1
+    tw = _TILE if p % _TILE == 0 else p
+
+    def pass1(i, acc):
+        sums, vmax, nn = acc
+        t = img_ref[0, :, pl.dslice(i * tw, tw)]        # (K, tw) f32
+        sums = sums + jnp.sum(t, axis=1, keepdims=True)
+        r0 = t[0:1]
+        vmax = jnp.maximum(vmax, jnp.max(r0, axis=1, keepdims=True))
+        nn = nn + jnp.sum((r0 > 0.0).astype(jnp.float32), axis=1,
+                          keepdims=True)
+        return sums, vmax, nn
+
+    sums0 = jnp.zeros((k, 1), jnp.float32)
+    vmax0 = jnp.full((1, 1), -jnp.inf, jnp.float32)
+    nn0 = jnp.zeros((1, 1), jnp.float32)
+    sums, vmax, nn = jax.lax.fori_loop(0, nt, pass1, (sums0, vmax0, nn0))
+    mean = sums / np.float32(p)                          # (K, 1)
+
+    def pass2(i, acc):
+        normsq, dots = acc
+        t = img_ref[0, :, pl.dslice(i * tw, tw)]
+        c = t - mean                                     # (K, tw) centered
+        c0 = c[0:1]                                      # principal row
+        normsq = normsq + jnp.sum(c * c, axis=1, keepdims=True)
+        dots = dots + jnp.sum(c0 * c, axis=1, keepdims=True)
+        return normsq, dots
+
+    z = jnp.zeros((k, 1), jnp.float32)
+    normsq, dots = jax.lax.fori_loop(0, nt, pass2, (z, z))
+
+    out = jnp.concatenate(
+        [sums, normsq, dots,
+         jnp.broadcast_to(vmax, (k, 1)), jnp.broadcast_to(nn, (k, 1))],
+        axis=1)                                          # (K, 5)
+    out_ref[0] = out
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def batch_moments_pallas(images: jnp.ndarray, interpret: bool = False):
+    """(sums (N,K), normsq (N,K), dots (N,K), vmax (N,), n_notnull (N,))
+    from an (N, K, P) image block, one streaming pass."""
+    n, k, p = images.shape
+    out = pl.pallas_call(
+        partial(_moments_kernel, k=k, p=p),
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, k, p), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, k, 5), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, k, 5), jnp.float32),
+        interpret=interpret,
+    )(images)
+    sums = out[:, :, 0]
+    normsq = out[:, :, 1]
+    dots = out[:, :, 2]
+    vmax = out[:, 0, 3]
+    nn = out[:, 0, 4]
+    return sums, normsq, dots, vmax, nn
+
+
+def batch_moments_jnp(images: jnp.ndarray):
+    """XLA fallback with identical semantics (non-TPU backends, or image
+    rows past the VMEM budget)."""
+    sums = images.sum(axis=-1)
+    mean = sums[..., None] / np.float32(images.shape[-1])
+    cent = images - mean
+    normsq = jnp.sum(cent * cent, axis=-1)
+    dots = jnp.einsum("np,nkp->nk", cent[:, 0, :], cent)
+    principal = images[:, 0, :]
+    vmax = principal.max(axis=1)
+    nn = jnp.sum((principal > 0).astype(jnp.float32), axis=1)
+    return sums, normsq, dots, vmax, nn
+
+
+def batch_moments(images: jnp.ndarray):
+    """Route to the Pallas kernel on TPU when the block shape fits."""
+    n, k, p = images.shape
+    if jax.default_backend() == "tpu" and moments_fit(k, p):
+        return batch_moments_pallas(images)
+    return batch_moments_jnp(images)

@@ -212,7 +212,8 @@ class ShardedJaxBackend:
         k_est = ds_config.isotope_generation.n_peaks
         b_loc = self.batch // n_form_shards
         p_loc_est = -(-ds.n_pixels // n_pix_shards)
-        scratch = 4 * (p_loc_est + 1) * (2 * b_loc * k_est + 4096)
+        # same clamped-scratch formula as the single-device guard
+        scratch = 4 * (p_loc_est + 1) * max(2 * b_loc * k_est + 1, 4098)
         if scratch > (8 << 30):
             raise ValueError(
                 f"per-shard histogram scratch would be ~{scratch / 2**30:.0f}"

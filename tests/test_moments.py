@@ -1,0 +1,86 @@
+"""Fused image-moments kernel (ops/moments_pallas.py) parity.
+
+The kernel feeds the spatial/spectral metrics and chaos thresholds from
+ONE streaming pass; its contract is the cross-backend one: sums/vmax/nn
+track the f64 reference to f32 rounding, and the ASSEMBLED correlation
+(what actually lands in MSM) stays within 1e-6 of the f64 oracle — raw
+centered sums are compared loosely (their error divides out against the
+norms).
+"""
+
+import numpy as np
+import pytest
+
+from sm_distributed_tpu.ops.moments_pallas import (
+    batch_moments_jnp,
+    batch_moments_pallas,
+    moments_fit,
+)
+
+
+def _f64_reference(img):
+    i64 = img.astype(np.float64)
+    sums = i64.sum(-1)
+    cent = i64 - i64.mean(-1, keepdims=True)
+    normsq = (cent * cent).sum(-1)
+    dots = (cent[:, 0:1, :] * cent).sum(-1)
+    vmax = i64[:, 0, :].max(-1)
+    nn = (i64[:, 0, :] > 0).sum(-1)
+    return sums, normsq, dots, vmax, nn
+
+
+def _corr(normsq, dots):
+    normsq = np.asarray(normsq, np.float64)
+    dots = np.asarray(dots, np.float64)
+    denom = np.sqrt(np.maximum(normsq[:, 0:1] * normsq, 0))
+    return np.where(denom > 0, dots / np.maximum(denom, 1e-30), 0.0)
+
+
+@pytest.mark.parametrize("shape", [(8, 4, 4096), (3, 4, 8192), (5, 2, 2048)])
+def test_moments_interpret_matches_f64(shape):
+    rng = np.random.default_rng(7)
+    n, k, p = shape
+    img = (rng.integers(0, 1 << 20, shape).astype(np.float32)
+           * (rng.random(shape) < 0.3))
+    got = batch_moments_pallas(np.asarray(img), interpret=True)
+    ref = _f64_reference(img)
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-6)        # sums
+    np.testing.assert_array_equal(np.asarray(got[3]), ref[3])    # vmax exact
+    np.testing.assert_array_equal(np.asarray(got[4]), ref[4])    # count exact
+    # assembled correlation within the cross-backend contract
+    np.testing.assert_allclose(
+        _corr(got[1], got[2]), _corr(ref[1], ref[2]), atol=1e-6, rtol=0)
+
+
+def test_moments_jnp_fallback_matches_f64():
+    rng = np.random.default_rng(3)
+    shape = (6, 4, 4096)
+    img = (rng.integers(0, 1 << 20, shape).astype(np.float32)
+           * (rng.random(shape) < 0.4))
+    got = batch_moments_jnp(np.asarray(img))
+    ref = _f64_reference(img)
+    np.testing.assert_allclose(got[0], ref[0], rtol=1e-6)
+    # the fallback is the pre-existing XLA formula (einsum over a
+    # materialized centered block); on this deliberately harsh synthetic
+    # (dense 40%, values to 2**20) its single-tree f32 reduce carries a
+    # few-1e-6 — real ion images sit well inside 1e-6 (backend parity
+    # tests); the Pallas kernel's tiled accumulation is tighter (above)
+    np.testing.assert_allclose(
+        _corr(got[1], got[2]), _corr(ref[1], ref[2]), atol=5e-6, rtol=0)
+
+
+def test_moments_fit_budget():
+    assert moments_fit(4, 262144)           # DESI 512x512
+    assert not moments_fit(4, 1024 * 1024)  # 1024x1024 -> fallback
+    assert not moments_fit(4, 100)          # non-128-multiple -> fallback
+
+
+def test_all_zero_and_single_pixel_rows():
+    """Empty images (padding ions) and constant rows must not NaN."""
+    img = np.zeros((2, 4, 2048), np.float32)
+    img[1, 0, 5] = 3.0
+    got = batch_moments_pallas(np.asarray(img), interpret=True)
+    sums, normsq, dots, vmax, nn = [np.asarray(x) for x in got]
+    assert np.all(np.isfinite(sums)) and np.all(np.isfinite(normsq))
+    assert vmax[0] == 0.0 and vmax[1] == 3.0
+    assert nn[0] == 0 and nn[1] == 1

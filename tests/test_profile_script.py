@@ -8,6 +8,6 @@ from scripts.profile_bench import profile
 def test_profile_bench_runs_on_tiny_workload(tmp_path):
     timings = profile(nrows=8, ncols=8, formula_batch=32, noise_peaks=10,
                       reps=1, cache_dir=tmp_path)
-    assert set(timings) == {"fused_full", "extract", "chaos", "correlation",
-                            "pattern"}
+    assert set(timings) == {"fused_full", "extract", "moments", "chaos",
+                            "correlation", "pattern"}
     assert all(t > 0 for t in timings.values())
