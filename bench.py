@@ -221,6 +221,13 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
                       # this host, but a repo path survives anything short
                       # of a fresh checkout (VERDICT r4 item 5)
                       "compile_cache_dir": str(cache_dir / "xla_cache")}})
+    # entries already in the persistent XLA cache before this case warms up
+    # (VERDICT r4 item 5 — 7 of ~13 driver-bench minutes were silent cold
+    # compiles).  All cases share the one cache dir, so 0 means certainly
+    # cold; nonzero means at least partially warm (earlier cases' entries
+    # count too — per-case key attribution isn't available from here)
+    cache_entries = len(list((cache_dir / "xla_cache").glob("*"))) \
+        if (cache_dir / "xla_cache").exists() else 0
     backend = make_backend("jax_tpu", prep["ds"], prep["ds_config"],
                            sm_config, table=prep["table"])
     batches = prep["batches"]
@@ -230,7 +237,8 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
     else:
         backend.score_batch(batches[0])
     compile_dt = time.perf_counter() - t0
-    logger.info("[%s] jax warmup/compile: %.1fs", cfg.name, compile_dt)
+    logger.info("[%s] jax warmup/compile: %.1fs (%d persistent-cache "
+                "entries before warmup)", cfg.name, compile_dt, cache_entries)
 
     # steady-state pipelined throughput: reps x batches enqueued as one
     # stream, one sync at the end (a production formula DB streams hundreds
@@ -254,7 +262,7 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
     logger.info("[%s] jax_tpu: median of 5 streams %.1f ions/s "
                 "(spread %.1f%%)", cfg.name, jax_rate, 100 * jax_spread)
     return dict(jax_rate=jax_rate, compile_dt=compile_dt,
-                jax_spread=jax_spread)
+                jax_spread=jax_spread, cache_entries=cache_entries)
 
 
 def report(prep: dict, floor: dict, jaxr: dict) -> dict:
@@ -270,6 +278,7 @@ def report(prep: dict, floor: dict, jaxr: dict) -> dict:
         "numpy_floor_multiproc_ions_per_s": round(floor["mp_rate"], 2),
         "vs_baseline_multiproc": round(jaxr["jax_rate"] / floor["mp_rate"], 2),
         "compile_s": round(jaxr["compile_dt"], 2),
+        "xla_cache_entries_before": jaxr["cache_entries"],
         "n_ions": int(prep["table"].n_ions),
         "n_pixels": int(prep["ds"].n_pixels),
         "pixels_per_s": round(jaxr["jax_rate"] * prep["ds"].n_pixels, 0),

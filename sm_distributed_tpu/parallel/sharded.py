@@ -289,14 +289,11 @@ class ShardedJaxBackend:
         ints_p[:n] = table.ints
         nv_p[:n] = table.n_valid
         # Per-formula-shard bound grids: shard f histograms only its windows.
-        f = self._n_form_shards
         n_px = self._mz_shards.shape[0]
-        b_loc = b // f
         poss, starts_l, rlo_l, rhi_l, invs, gc = [], [], [], [], [], 0
         runs_sf: list[list] = [[] for _ in range(n_px)]  # [s][f] run plans
         bands_sf: list[list] = [[] for _ in range(n_px)]  # [s][f] rank bands
-        for fi, (sl, grid, rl, rh, pos_rows) in enumerate(
-                self._shard_grids(lo_p, hi_p)):
+        for sl, _grid, rl, rh, pos_rows in self._shard_grids(lo_p, hi_p):
             st, rll, rhl, inv, gcs = window_chunks(rl, rh, _BAND_WINDOWS)
             gc = max(gc, gcs)
             starts_l.append(st)
