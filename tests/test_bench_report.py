@@ -1,0 +1,45 @@
+"""Schema pin for bench.py's JSON report (the driver parses the one JSON
+line; BENCH_r*.json is the judged table of record, so silently dropping a
+field is a protocol break, not a refactor)."""
+
+from bench import report
+
+
+def _fake_inputs():
+    class Obj:
+        pass
+
+    table = Obj()
+    table.n_ions = 100
+    ds = Obj()
+    ds.n_pixels = 4096
+    prep = {"table": table, "ds": ds, "isocalc_dt": 0.5}
+    floor = dict(np_rate=50.0, mp_rate=50.0, n_procs=1, floor_n_ions=100,
+                 floor_spread=0.1, floor_spread_mid5=0.05)
+    jaxr = dict(jax_rate=5000.0, compile_dt=12.0, jax_spread=0.02,
+                cache_entries=7)
+    return prep, floor, jaxr
+
+
+def test_report_schema_and_values():
+    out = report(*_fake_inputs())
+    assert set(out) == {
+        "value", "jax_spread", "vs_baseline", "numpy_floor_ions_per_s",
+        "numpy_floor_spread", "numpy_floor_spread_mid5",
+        "numpy_floor_n_ions", "floor_procs",
+        "numpy_floor_multiproc_ions_per_s", "vs_baseline_multiproc",
+        "compile_s", "xla_cache_entries_before", "n_ions", "n_pixels",
+        "pixels_per_s", "isocalc_s",
+    }
+    assert out["value"] == 5000.0
+    assert out["vs_baseline"] == 100.0
+    assert out["jax_spread"] == 0.02
+    assert out["compile_s"] == 12.0
+    assert out["xla_cache_entries_before"] == 7
+    assert out["numpy_floor_ions_per_s"] == 50.0
+    assert out["numpy_floor_spread_mid5"] == 0.05
+    assert out["floor_procs"] == 1
+    assert out["vs_baseline_multiproc"] == 100.0
+    assert out["n_ions"] == 100 and out["n_pixels"] == 4096
+    assert out["pixels_per_s"] == 5000.0 * 4096
+    assert out["isocalc_s"] == 0.5
