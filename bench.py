@@ -232,10 +232,23 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
                            sm_config, table=prep["table"])
     batches = prep["batches"]
     t0 = time.perf_counter()
-    if hasattr(backend, "warmup"):
-        backend.warmup(batches)
-    else:
-        backend.score_batch(batches[0])
+    for attempt in (1, 2):
+        try:
+            if hasattr(backend, "warmup"):
+                backend.warmup(batches)
+            else:
+                backend.score_batch(batches[0])
+            break
+        except Exception:
+            # the tunneled TPU's remote-compile transport occasionally drops
+            # a response mid-read (observed ~1 in 10 runs: "response body
+            # closed before all bytes were read"); one retry has always
+            # succeeded, and losing a whole bench run to it is worse than
+            # a retried warmup's inflated compile_s
+            if attempt == 2:
+                raise
+            logger.warning("[%s] warmup failed (transient tunnel error?); "
+                           "retrying once", cfg.name, exc_info=True)
     compile_dt = time.perf_counter() - t0
     logger.info("[%s] jax warmup/compile: %.1fs (%d persistent-cache "
                 "entries before warmup)", cfg.name, compile_dt, cache_entries)
