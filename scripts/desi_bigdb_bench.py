@@ -6,9 +6,11 @@ formulas x (1 target + 20 decoy) adducts = ~1.68M ions — "DESI whole-slide
 high-res, ChEBI + 20 decoy adducts" (SURVEY.md §6 config #5 [U]).  The
 default bench's ``desi`` case runs the same pixel count at 500 formulas;
 the cold-path script runs the same DB at 100x100 px; this is the first
-measurement that combines both axes, which is where the HBM plan (~2.2 GB
-resident peaks + per-batch band scratch), the sticky band-bucket ladder
-over ~6.5k batches, and sustained-stream throughput actually get stressed.
+measurement that combines both axes, which is where the HBM plan (pre-run
+estimate ~2.2 GB resident peaks + per-batch band scratch; the measured run
+came to 1.95 GB after window-union restriction — docs/PERF.md), the sticky
+band-bucket ladder over ~6.5k batches, and sustained-stream throughput
+actually get stressed.
 
 Reuses the default bench's 512x512 fixture (same generator parameters) and
 the cold-path run's isocalc shard cache when present (same formula list,

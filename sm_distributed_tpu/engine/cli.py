@@ -15,6 +15,11 @@ runs SearchJob.  Usage:
 
     python -m sm_distributed_tpu.engine.cli search [--ds-id ID] \\
         [--max-fdr 0.1] [--sm-config sm.json]
+
+    python -m sm_distributed_tpu.engine.cli serve QUEUE_DIR \\
+        [--sm-config sm.json] [--workers N] [--port P] [--no-api]
+    # long-running annotation service: concurrent scheduler + retry/backoff
+    # + /healthz /metrics /jobs /submit admin API (docs/SERVICE.md)
 """
 
 from __future__ import annotations
@@ -86,6 +91,50 @@ def cmd_search(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Run the annotation service: concurrent scheduler + admin API over a
+    spool queue directory (sm_distributed_tpu.service)."""
+    import dataclasses
+
+    sm_config = _load_configs(args)
+    overrides = {}
+    if args.workers is not None:
+        overrides["workers"] = args.workers
+    if args.port is not None:
+        overrides["http_port"] = args.port
+    if args.host is not None:
+        overrides["http_host"] = args.host
+    if overrides:
+        sm_config = dataclasses.replace(
+            sm_config,
+            service=dataclasses.replace(sm_config.service, **overrides))
+        SMConfig.set(sm_config)
+    from ..service import AnnotationService
+    from .daemon import annotate_callback
+
+    residency = None
+    if sm_config.parallel.resident_datasets > 0:
+        from .residency import DatasetResidency
+
+        n = sm_config.parallel.resident_datasets
+        residency = DatasetResidency(max_datasets=n, max_backends=n)
+    service = AnnotationService(
+        args.queue_dir,
+        annotate_callback(sm_config, residency=residency),
+        sm_config=sm_config,
+        residency=residency,
+        with_api=not args.no_api,
+    )
+    service.install_signal_handlers()
+    service.start()
+    if service.api is not None:
+        host, port = service.api.address
+        logger.info("serve: admin API on http://%s:%d "
+                    "(/healthz /metrics /jobs POST /submit)", host, port)
+    return service.run_forever(max_terminal=args.max_jobs,
+                               idle_timeout_s=args.idle_timeout)
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="sm-tpu")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -119,6 +168,23 @@ def build_parser() -> argparse.ArgumentParser:
     srch.add_argument("--mz-max", type=float, default=None)
     srch.add_argument("--sm-config", default=None)
     srch.set_defaults(fn=cmd_search)
+
+    srv = sub.add_parser(
+        "serve", help="run the annotation service (scheduler + admin API)")
+    srv.add_argument("queue_dir", help="spool queue directory")
+    srv.add_argument("--sm-config", default=None)
+    srv.add_argument("--workers", type=int, default=None,
+                     help="override service.workers")
+    srv.add_argument("--host", default=None, help="override service.http_host")
+    srv.add_argument("--port", type=int, default=None,
+                     help="override service.http_port (0 = ephemeral)")
+    srv.add_argument("--no-api", action="store_true",
+                     help="run the scheduler without the admin API")
+    srv.add_argument("--max-jobs", type=int, default=None,
+                     help="exit after N jobs reach a terminal state")
+    srv.add_argument("--idle-timeout", type=float, default=None,
+                     help="exit after the spool stays empty this many seconds")
+    srv.set_defaults(fn=cmd_serve)
     return ap
 
 

@@ -11,13 +11,21 @@ the daemon claims a message by atomically renaming it into ``running/``
 (rename is the ack/visibility mechanism — two daemons cannot claim the same
 message), runs the job, then moves it to ``done/`` or ``failed/`` (the fail
 queue).  Crash recovery: messages stuck in ``running/`` can be requeued with
-``requeue_stale()``.
+``requeue_stale()``, which is heartbeat-aware (see ``ClaimHeartbeat``) so a
+slow-but-alive job is not confused with a crashed claim.
+
+The production serving shape on top of this spool contract — concurrent
+scheduler, retry/backoff/dead-letter, metrics, admin API — lives in
+``sm_distributed_tpu.service`` (the ``serve`` CLI command, docs/SERVICE.md);
+this module stays the minimal one-message-at-a-time consumer and the shared
+spool primitives.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import uuid
 from pathlib import Path
@@ -27,6 +35,56 @@ from ..utils.logger import logger
 
 QUEUE_ANNOTATE = "sm_annotate"
 _STATES = ("pending", "running", "done", "failed")
+
+
+def heartbeat_path(msg_path: Path) -> Path:
+    """Sidecar heartbeat file for a claimed message (``<id>.json.hb``).
+
+    The ``*.json`` globs never match it, so it is invisible to claim/requeue
+    scans except where explicitly consulted."""
+    return msg_path.with_name(msg_path.name + ".hb")
+
+
+def touch_heartbeat(msg_path: Path) -> None:
+    hb = heartbeat_path(msg_path)
+    hb.touch()
+    # mtime-based liveness: touch() alone may not advance mtime on coarse
+    # filesystems, so force it
+    now = time.time()
+    os.utime(hb, (now, now))
+
+
+def clear_heartbeat(msg_path: Path) -> None:
+    try:
+        heartbeat_path(msg_path).unlink()
+    except FileNotFoundError:
+        pass
+
+
+class ClaimHeartbeat(threading.Thread):
+    """Background thread touching a claimed message's heartbeat file every
+    ``interval_s`` while its job runs, so ``requeue_stale()`` can tell a slow
+    job (live heartbeat) from a crashed claim (dead/absent heartbeat)."""
+
+    def __init__(self, msg_path: Path, interval_s: float = 5.0):
+        super().__init__(daemon=True, name=f"hb-{msg_path.stem}")
+        self.msg_path = Path(msg_path)
+        self.interval_s = interval_s
+        # NB: name must not collide with threading.Thread's internal _stop
+        self._halt = threading.Event()
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                touch_heartbeat(self.msg_path)
+            except OSError:
+                pass                  # message already moved to a terminal dir
+            self._halt.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._halt.set()
+        self.join(timeout=2.0)
+        clear_heartbeat(self.msg_path)
 
 
 class QueuePublisher:
@@ -114,12 +172,27 @@ class QueueConsumer:
         return True
 
     def requeue_stale(self, max_age_s: float = 0.0) -> int:
-        """Move crashed messages from running/ back to pending/."""
+        """Move crashed messages from running/ back to pending/.
+
+        Heartbeat-aware: a claim's freshest sign of life is its heartbeat
+        sidecar's mtime when one exists (the service scheduler touches it
+        every ``heartbeat_interval_s``), else the message file's own mtime.
+        A claim is requeued only when that is at least ``max_age_s`` old —
+        so with ``max_age_s > heartbeat_interval_s`` a slow-but-alive job
+        survives while a crashed claim (dead heartbeat) is recovered.  The
+        default ``max_age_s=0`` keeps the original recover-everything
+        behavior for cold daemon starts."""
         n = 0
         now = time.time()
         for p in self.root.glob("running/*.json"):
-            if now - p.stat().st_mtime >= max_age_s:
+            hb = heartbeat_path(p)
+            try:
+                ref_mtime = hb.stat().st_mtime if hb.exists() else p.stat().st_mtime
+            except FileNotFoundError:
+                continue              # finished between glob and stat
+            if now - ref_mtime >= max_age_s:
                 os.replace(p, self.root / "pending" / p.name)
+                clear_heartbeat(p)
                 n += 1
         return n
 
@@ -149,7 +222,7 @@ def annotate_callback(sm_config: SMConfig, residency=None):
         n = sm_config.parallel.resident_datasets
         residency = DatasetResidency(max_datasets=n, max_backends=n)
 
-    def cb(msg: dict) -> None:
+    def cb(msg: dict, ctx=None) -> None:
         from .search_job import SearchJob
 
         ds_config = (
@@ -163,6 +236,9 @@ def annotate_callback(sm_config: SMConfig, residency=None):
             sm_config=sm_config,
             formulas=msg.get("formulas"),
             residency=residency,
+            # service scheduler: serialize the device-bound phases across
+            # worker threads while staging/parse overlap
+            device_token=getattr(ctx, "device_token", None),
         ).run(clean=bool(msg.get("clean")))
 
     return cb

@@ -18,32 +18,48 @@ ion table) plus every backend-shaping parallel knob.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 
 from ..utils.logger import logger
 
 
 class _LRU:
+    """Thread-safe LRU.  The service scheduler's workers share one residency
+    across concurrent jobs; the lock guards only the dict bookkeeping, NOT
+    ``builder()`` — holding it through a minutes-long parse would serialize
+    exactly the CPU staging the scheduler exists to overlap.  Two workers
+    missing on the same key may therefore both build; the first insert wins
+    and the duplicate is dropped (device-backend builds don't race in
+    practice because they run under the scheduler's TPU token)."""
+
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
         self.data: OrderedDict = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self._lock = threading.Lock()
 
     def get_or_build(self, key, builder):
-        if self.maxsize <= 0:
-            self.misses += 1
-            return builder()
-        if key in self.data:
-            self.hits += 1
-            self.data.move_to_end(key)
-            return self.data[key]
-        self.misses += 1
+        with self._lock:
+            if self.maxsize <= 0:
+                self.misses += 1
+            elif key in self.data:
+                self.hits += 1
+                self.data.move_to_end(key)
+                return self.data[key]
+            else:
+                self.misses += 1
         val = builder()
-        self.data[key] = val
-        while len(self.data) > self.maxsize:
-            old_key, _old = self.data.popitem(last=False)
-            logger.info("residency: evicted %s", old_key[0] if old_key else old_key)
+        if self.maxsize <= 0:
+            return val
+        with self._lock:
+            if key in self.data:       # concurrent builder won — reuse theirs
+                return self.data[key]
+            self.data[key] = val
+            while len(self.data) > self.maxsize:
+                old_key, _old = self.data.popitem(last=False)
+                logger.info("residency: evicted %s", old_key[0] if old_key else old_key)
         return val
 
 

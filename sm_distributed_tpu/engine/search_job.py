@@ -40,6 +40,7 @@ class SearchJob:
         formulas: list[str] | None = None,
         profile_dir: str | None = None,
         residency=None,
+        device_token=None,
     ):
         self.ds_id = ds_id
         self.ds_name = ds_name
@@ -54,6 +55,10 @@ class SearchJob:
         # service mode: engine/residency.DatasetResidency shared across jobs
         # keeps parsed datasets + compiled backends warm (SURVEY #16 analog)
         self.residency = residency
+        # service scheduler's TPU token (a lock/context manager): when set,
+        # the device-bound compile+search+store phase of concurrent jobs
+        # serializes here while their staging/parse phases overlap
+        self.device_token = device_token
         self.ledger = JobLedger(self.sm_config.storage.results_dir)
         self.store = SearchResultsStore(
             self.ledger,
@@ -96,35 +101,43 @@ class SearchJob:
 
                 prof = self.profile_dir
                 jax.profiler.start_trace(prof)
-            search = MSMBasicSearch(
-                ds, formulas, self.ds_config, self.sm_config,
-                isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),
-                checkpoint_dir=str(self.work_dir.path),
-                backend_cache=self.residency,
-            )
-            bundle = search.search()
-            if prof:
-                import jax
+            import contextlib
 
-                jax.profiler.stop_trace()
-                prof = None
-                logger.info("profile trace written to %s", self.profile_dir)
-            bundle.timings.update(timings)
-            with phase_timer("store_results", bundle.timings):
-                ion_mzs = {
-                    (table_sf, table_ad): mz
-                    for table_sf, table_ad, mz in zip(
-                        search.last_table.sfs,
-                        search.last_table.adducts,
-                        search.last_table.mzs[:, 0],
-                    )
-                } if search.last_table is not None else None
-                # images first, index/parquet swap last: a failure anywhere
-                # in storage leaves the previous successful job's results
-                # fully queryable (ADVICE r1)
-                if self.sm_config.storage.store_images:
-                    self._store_annotation_images(ds, search, bundle)
-                self.store.store(self.ds_id, job_id, bundle, ion_mzs)
+            # everything up to here is CPU-bound (staging, parse, formula
+            # lookup) and overlaps freely across scheduler workers; from the
+            # backend build through result storage the device is involved,
+            # so concurrent service jobs serialize on the TPU token
+            token = self.device_token or contextlib.nullcontext()
+            with token:
+                search = MSMBasicSearch(
+                    ds, formulas, self.ds_config, self.sm_config,
+                    isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),
+                    checkpoint_dir=str(self.work_dir.path),
+                    backend_cache=self.residency,
+                )
+                bundle = search.search()
+                if prof:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                    prof = None
+                    logger.info("profile trace written to %s", self.profile_dir)
+                bundle.timings.update(timings)
+                with phase_timer("store_results", bundle.timings):
+                    ion_mzs = {
+                        (table_sf, table_ad): mz
+                        for table_sf, table_ad, mz in zip(
+                            search.last_table.sfs,
+                            search.last_table.adducts,
+                            search.last_table.mzs[:, 0],
+                        )
+                    } if search.last_table is not None else None
+                    # images first, index/parquet swap last: a failure anywhere
+                    # in storage leaves the previous successful job's results
+                    # fully queryable (ADVICE r1)
+                    if self.sm_config.storage.store_images:
+                        self._store_annotation_images(ds, search, bundle)
+                    self.store.store(self.ds_id, job_id, bundle, ion_mzs)
             self.ledger.finish_job(job_id)
             if search.last_checkpoint is not None:
                 # only after results are durably persisted: a storage failure

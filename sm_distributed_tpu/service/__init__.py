@@ -1,0 +1,37 @@
+"""Service layer — long-running annotation service over the spool daemon.
+
+The reference deploys the engine behind RabbitMQ with one blocking consumer
+per daemon process (SURVEY.md #16); this subsystem is the production-serving
+shape the ROADMAP north star asks for on top of the same spool contract:
+
+- ``scheduler``  — concurrent job scheduler: worker pool draining the spool,
+  priority classes + per-tenant fairness, device-bound phases serialized via
+  a TPU token while CPU staging/parse overlap;
+- ``scheduler``  — failure policy: per-job timeout, retry with exponential
+  backoff + jitter, bounded attempts, dead-letter into ``failed/`` with the
+  recorded traceback, heartbeat files for crash-vs-slow discrimination;
+- ``metrics``    — counters/gauges/histograms with Prometheus text
+  exposition, threaded through ``phase_timer`` and ``DatasetResidency``;
+- ``api``        — stdlib ``http.server`` admin API (``/healthz``,
+  ``/metrics``, ``/jobs``, ``POST /submit``);
+- ``server``     — ``AnnotationService`` composing all of the above with
+  graceful SIGTERM shutdown (drain running, requeue claimed-but-unstarted).
+
+Everything here is exercisable on CPU (``JAX_PLATFORMS=cpu``) with fake job
+callbacks — see ``tests/test_service.py``.
+"""
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .scheduler import JobRecord, JobScheduler, RetryPolicy
+from .server import AnnotationService
+
+__all__ = [
+    "AnnotationService",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JobRecord",
+    "JobScheduler",
+    "MetricsRegistry",
+    "RetryPolicy",
+]

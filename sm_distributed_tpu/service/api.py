@@ -1,0 +1,136 @@
+"""Admin API over stdlib ``http.server`` — no web framework in the image.
+
+Endpoints (the reference exposes none of this; operators had to shell into
+RabbitMQ's management UI):
+
+- ``GET /healthz``   liveness + spool depths; 200 while serving, 503 once
+  shutdown has begun (load balancers stop routing before the drain ends);
+- ``GET /metrics``   Prometheus text exposition from the service registry;
+- ``GET /jobs``      JSON array of the scheduler's job records (filter with
+  ``?state=running`` etc.);
+- ``POST /submit``   body = a spool message (``ds_id`` + ``input_path`` at
+  minimum, optional ``priority``/``tenant``/``service.timeout_s``); returns
+  ``{"msg_id": ...}`` 202.  Publishing goes through ``QueuePublisher`` so a
+  submitted job is durable before the response leaves.
+
+``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
+handler is read-only except ``/submit``, which only appends to ``pending/``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..utils.logger import logger
+
+
+class AdminAPI:
+    """Own the HTTP server thread; routes delegate to the service object."""
+
+    def __init__(self, service, host: str = "127.0.0.1", port: int = 0):
+        self.service = service
+        api = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route access logs to ours
+                logger.debug("admin-api: " + fmt, *args)
+
+            def _reply(self, status: int, body: bytes, ctype: str) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, status: int, obj) -> None:
+                self._reply(status, json.dumps(obj).encode(),
+                            "application/json")
+
+            def do_GET(self):
+                try:
+                    url = urlparse(self.path)
+                    if url.path == "/healthz":
+                        body, status = api._healthz()
+                        self._reply_json(status, body)
+                    elif url.path == "/metrics":
+                        text = api.service.metrics.expose()
+                        self._reply(200, text.encode(),
+                                    "text/plain; version=0.0.4")
+                    elif url.path == "/jobs":
+                        q = parse_qs(url.query)
+                        self._reply_json(200, api._jobs(q.get("state", [None])[0]))
+                    else:
+                        self._reply_json(404, {"error": "not found"})
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("admin-api: GET %s failed", self.path,
+                                 exc_info=True)
+                    self._reply_json(500, {"error": str(exc)})
+
+            def do_POST(self):
+                try:
+                    if urlparse(self.path).path != "/submit":
+                        self._reply_json(404, {"error": "not found"})
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(n) if n else b""
+                    try:
+                        msg = json.loads(raw or b"{}")
+                        if not isinstance(msg, dict):
+                            raise ValueError("message must be a JSON object")
+                        dst = api.service.publisher.publish(msg)
+                    except (ValueError, json.JSONDecodeError) as exc:
+                        self._reply_json(400, {"error": str(exc)})
+                        return
+                    self._reply_json(202, {"msg_id": dst.stem,
+                                           "spooled": str(dst)})
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("admin-api: POST %s failed", self.path,
+                                 exc_info=True)
+                    self._reply_json(500, {"error": str(exc)})
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    # --------------------------------------------------------------- routes
+    def _healthz(self) -> tuple[dict, int]:
+        svc = self.service
+        stats = svc.scheduler.stats()
+        body = {
+            "status": "stopping" if stats["stopping"] else "ok",
+            "uptime_s": round(time.time() - svc.started_at, 3),
+            "workers": stats["workers"],
+            "jobs": stats["states"],
+            "queue": svc.queue_depths(),
+        }
+        return body, (503 if stats["stopping"] else 200)
+
+    def _jobs(self, state: str | None) -> list[dict]:
+        jobs = self.service.scheduler.jobs()
+        if state:
+            jobs = [j for j in jobs if j["state"] == state]
+        return jobs
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def address(self) -> tuple[str, int]:
+        return self._server.server_address[:2]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.2},
+            daemon=True, name="admin-api")
+        self._thread.start()
+        logger.info("admin-api: listening on http://%s:%d", *self.address)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

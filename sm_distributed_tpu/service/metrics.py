@@ -1,0 +1,262 @@
+"""Metrics registry with Prometheus text exposition (stdlib only).
+
+The reference exposes no metrics at all — operators watch Spark UI and
+RabbitMQ's management plugin.  The service layer needs its own first-class
+observability: counters (monotone totals), gauges (point-in-time values),
+and histograms (cumulative buckets, Prometheus semantics), all thread-safe
+because scheduler workers record concurrently, plus *collect callbacks* so
+existing stat holders (``DatasetResidency.stats``, spool directory depths)
+can be scraped without restructuring them into push-style instruments.
+
+Exposition follows the Prometheus text format v0.0.4: ``# HELP`` / ``# TYPE``
+headers, ``name{label="value"} 1.0`` samples, histogram ``_bucket{le=...}`` /
+``_sum`` / ``_count`` series with a ``+Inf`` bucket.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+# Default buckets span the service's realities: sub-ms fake jobs in tests up
+# through multi-hour whole-slide searches (docs/PERF.md: 32 min DESI jobs).
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.025, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0, 3600.0,
+)
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Metric:
+    """Base: a named family with labelled children."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple[str, ...], object] = {}
+
+    def labels(self, **kw):
+        if set(kw) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, got {sorted(kw)}")
+        key = tuple(str(kw[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._make_child()
+            return child
+
+    def _default_child(self):
+        """Unlabelled metrics act on a single implicit child."""
+        if self.labelnames:
+            raise ValueError(f"{self.name} requires labels {self.labelnames}")
+        return self.labels()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def _sample_lines(self) -> list[str]:
+        raise NotImplementedError
+
+    def expose(self) -> list[str]:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {_escape(self.help)}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        with self._lock:
+            lines.extend(self._sample_lines())
+        return lines
+
+    def _label_dict(self, key: tuple[str, ...]) -> dict[str, str]:
+        return dict(zip(self.labelnames, key))
+
+
+class _CounterChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        with self._lock:
+            self.value += amount
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def _sample_lines(self) -> list[str]:
+        return [
+            f"{self.name}{_fmt_labels(self._label_dict(k))} {_fmt_value(c.value)}"
+            for k, c in sorted(self._children.items())
+        ]
+
+
+class _GaugeChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild()
+
+    def set(self, value: float) -> None:
+        self._default_child().set(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default_child().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default_child().dec(amount)
+
+    def _sample_lines(self) -> list[str]:
+        return [
+            f"{self.name}{_fmt_labels(self._label_dict(k))} {_fmt_value(c.value)}"
+            for k, c in sorted(self._children.items())
+        ]
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+
+    def __init__(self, buckets: tuple[float, ...]):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)   # per-bucket (non-cumulative) counts
+        self.sum = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect_left(self.buckets, value)
+        with self._lock:
+            if i < len(self.buckets):
+                self.counts[i] += 1
+            self.sum += value
+            self.count += 1
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._default_child().observe(value)
+
+    def _sample_lines(self) -> list[str]:
+        lines = []
+        for key, c in sorted(self._children.items()):
+            base = self._label_dict(key)
+            cum = 0
+            for le, n in zip(c.buckets, c.counts):
+                cum += n
+                lines.append(
+                    f"{self.name}_bucket{_fmt_labels({**base, 'le': _fmt_value(le)})} {cum}")
+            lines.append(
+                f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} {c.count}")
+            lines.append(f"{self.name}_sum{_fmt_labels(base)} {_fmt_value(c.sum)}")
+            lines.append(f"{self.name}_count{_fmt_labels(base)} {c.count}")
+        return lines
+
+
+class MetricsRegistry:
+    """Registry: owns metric families + scrape-time collect callbacks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self._collectors: list = []
+
+    def _register(self, metric: _Metric) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    raise ValueError(
+                        f"metric {metric.name} re-registered with a different type")
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._register(Counter(name, help, labelnames))
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._register(Gauge(name, help, labelnames))
+
+    def histogram(self, name, help="", labelnames=(), buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(name, help, labelnames, buckets))
+
+    def add_collector(self, fn) -> None:
+        """``fn(registry)`` runs at each scrape BEFORE exposition — the hook
+        that pulls ``DatasetResidency.stats`` / spool depths into gauges."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def expose(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn(self)
+            except Exception:  # a broken collector must not kill /metrics
+                from ..utils.logger import logger
+
+                logger.warning("metrics collector %r failed", fn, exc_info=True)
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        out = []
+        for m in metrics:
+            out.extend(m.expose())
+        return "\n".join(out) + "\n"

@@ -1,0 +1,554 @@
+"""Concurrent job scheduler over the file-spool queue.
+
+Replaces the daemon's one-message-at-a-time blocking loop
+(``engine/daemon.py::QueueConsumer.run``) with a production serving shape:
+
+- a **dispatcher** thread scans ``pending/`` and admits messages in
+  (priority class, per-tenant fairness, FIFO) order, claiming each by the
+  same atomic rename the daemon uses, into a bounded hand-off queue;
+- a **worker pool** executes claimed jobs concurrently.  Device-bound
+  phases are serialized through a single **TPU token** (``device_token``,
+  handed to the callback via ``JobContext`` and acquired inside
+  ``SearchJob.run`` around the compiled-search phase) so CPU-bound
+  staging/parse of the next job overlaps the current job's device time —
+  the service-level analog of the host/device pipelining the backends do
+  per batch;
+- a **failure policy**: per-job timeout (message ``timeout_s`` overrides
+  the config default), retry with exponential backoff + jitter, bounded
+  attempts, then dead-letter into ``failed/`` with the recorded traceback.
+  Retries persist their state (``attempts``, ``next_retry_at``) INTO the
+  message file and move it back to ``pending/`` — a scheduler crash between
+  attempts loses nothing;
+- **heartbeat files** (``engine/daemon.py::ClaimHeartbeat``) touched for
+  every running claim, so ``requeue_stale()`` distinguishes crashed claims
+  from slow jobs;
+- graceful drain: ``shutdown()`` stops admission, requeues
+  claimed-but-unstarted messages, waits for running jobs, and leaves
+  ``running/`` empty.
+
+Priority classes come from message metadata: ``priority`` is ``"high"`` /
+``"normal"`` / ``"low"`` (or an int, lower = sooner); ``tenant`` scopes
+fairness — among equal priorities the dispatcher favors the tenant with the
+fewest in-flight jobs, so one tenant's burst cannot starve the rest.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue as _queue_mod
+import random
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..engine.daemon import (
+    QUEUE_ANNOTATE,
+    ClaimHeartbeat,
+    _STATES,
+    clear_heartbeat,
+)
+from ..utils.config import ServiceConfig
+from ..utils.logger import logger
+
+PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
+
+# terminal + live job states surfaced via /jobs
+JOB_STATES = ("queued", "claimed", "running", "retry_wait", "done", "failed")
+
+
+def _priority_rank(value) -> int:
+    if isinstance(value, (int, float)):
+        return int(value)
+    return PRIORITY_CLASSES.get(str(value), PRIORITY_CLASSES["normal"])
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with additive jitter; attempts are bounded."""
+
+    max_attempts: int = 3
+    base_s: float = 1.0
+    max_s: float = 60.0
+    jitter: float = 0.1            # delay *= 1 + U[0, jitter]
+
+    def backoff_s(self, attempt: int) -> float:
+        """Delay before retry number ``attempt`` (1-based: after the first
+        failure attempt=1).  Always >= base_s * 2^(attempt-1) capped at
+        max_s; jitter only ADDS (de-synchronizes retry thundering herds
+        without ever retrying early)."""
+        delay = min(self.max_s, self.base_s * (2.0 ** (attempt - 1)))
+        return delay * (1.0 + random.random() * self.jitter)
+
+    @staticmethod
+    def from_config(cfg: ServiceConfig) -> "RetryPolicy":
+        return RetryPolicy(
+            max_attempts=cfg.max_attempts,
+            base_s=cfg.backoff_base_s,
+            max_s=cfg.backoff_max_s,
+            jitter=cfg.backoff_jitter,
+        )
+
+
+@dataclass
+class JobRecord:
+    """In-memory tracking row for one message (served by ``GET /jobs``)."""
+
+    msg_id: str
+    ds_id: str = ""
+    tenant: str = "default"
+    priority: str | int = "normal"
+    state: str = "queued"
+    attempts: int = 0
+    published_at: float = 0.0
+    claimed_at: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    next_retry_at: float = 0.0
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "msg_id": self.msg_id, "ds_id": self.ds_id, "tenant": self.tenant,
+            "priority": self.priority, "state": self.state,
+            "attempts": self.attempts, "published_at": self.published_at,
+            "claimed_at": self.claimed_at, "started_at": self.started_at,
+            "finished_at": self.finished_at,
+            "next_retry_at": self.next_retry_at, "error": self.error,
+        }
+
+
+@dataclass
+class JobContext:
+    """Handed to callbacks that accept a second argument."""
+
+    msg_id: str
+    attempt: int
+    device_token: threading.Lock = field(repr=False, default=None)
+    metrics: object = field(repr=False, default=None)
+
+
+def _callback_takes_ctx(fn) -> bool:
+    """Callbacks may be legacy single-arg (``cb(msg)``, plain daemon style)
+    or service-aware (``cb(msg, ctx)``)."""
+    import inspect
+
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    positional = [
+        p for p in params
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    if any(p.kind == p.VAR_POSITIONAL for p in params):
+        return True
+    return len(positional) >= 2
+
+
+class _Attempt(threading.Thread):
+    """One callback invocation, joinable with a timeout.  A timed-out
+    attempt thread is abandoned (daemon thread — Python cannot kill it);
+    all spool file moves happen in the owning worker, so a zombie attempt
+    can never corrupt queue state."""
+
+    def __init__(self, fn, msg, ctx, takes_ctx: bool):
+        super().__init__(daemon=True, name=f"attempt-{ctx.msg_id}-{ctx.attempt}")
+        self.fn, self.msg, self.ctx, self.takes_ctx = fn, msg, ctx, takes_ctx
+        self.error: BaseException | None = None
+        self.tb: str = ""
+
+    def run(self) -> None:
+        try:
+            if self.takes_ctx:
+                self.fn(self.msg, self.ctx)
+            else:
+                self.fn(self.msg)
+        except BaseException as exc:  # noqa: BLE001 — recorded, not swallowed
+            self.error = exc
+            self.tb = traceback.format_exc()
+
+
+class JobScheduler:
+    """Drain the spool with a worker pool under the service failure policy."""
+
+    def __init__(
+        self,
+        queue_dir: str | Path,
+        callback,
+        config: ServiceConfig | None = None,
+        queue: str = QUEUE_ANNOTATE,
+        metrics=None,
+    ):
+        self.root = Path(queue_dir) / queue
+        for s in _STATES:
+            (self.root / s).mkdir(parents=True, exist_ok=True)
+        self.callback = callback
+        self._cb_takes_ctx = _callback_takes_ctx(callback)
+        self.cfg = config or ServiceConfig()
+        self.retry = RetryPolicy.from_config(self.cfg)
+        self.metrics = metrics
+        # ONE token: device-bound phases of concurrent jobs serialize here
+        self.device_token = threading.Lock()
+        self._records: dict[str, JobRecord] = {}
+        self._records_lock = threading.Lock()
+        # bounded hand-off: at most `workers` messages sit claimed-but-
+        # unstarted, so a SIGTERM drain requeues a bounded set
+        self._handoff: _queue_mod.Queue = _queue_mod.Queue(maxsize=max(1, self.cfg.workers))
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._inflight_by_tenant: dict[str, int] = {}
+        self._terminal_count = 0
+        self._started = False
+        if metrics is not None:
+            self._init_metrics(metrics)
+
+    # ------------------------------------------------------------- metrics
+    def _init_metrics(self, m) -> None:
+        self.m_jobs = m.counter(
+            "sm_jobs_total", "Terminal job outcomes by state", ("state",))
+        self.m_retries = m.counter(
+            "sm_job_retries_total", "Retry attempts scheduled")
+        self.m_timeouts = m.counter(
+            "sm_job_timeouts_total", "Attempts killed by the per-job timeout")
+        self.m_running = m.gauge(
+            "sm_jobs_running", "Jobs currently executing in the worker pool")
+        self.m_duration = m.histogram(
+            "sm_job_duration_seconds", "Per-attempt job wall clock")
+        self.m_backoff = m.histogram(
+            "sm_retry_backoff_seconds", "Backoff delays scheduled before retries",
+            buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0))
+        m.add_collector(self._collect_queue_depths)
+
+    def _collect_queue_depths(self, m) -> None:
+        g = m.gauge("sm_queue_depth", "Messages per spool state", ("state",))
+        for s in _STATES:
+            g.labels(state=s).set(len(list(self.root.glob(f"{s}/*.json"))))
+
+    # ------------------------------------------------------------- records
+    def _record(self, msg_id: str) -> JobRecord:
+        with self._records_lock:
+            rec = self._records.get(msg_id)
+            if rec is None:
+                rec = self._records[msg_id] = JobRecord(msg_id=msg_id)
+            return rec
+
+    def jobs(self) -> list[dict]:
+        with self._records_lock:
+            return [r.to_dict() for r in self._records.values()]
+
+    def stats(self) -> dict:
+        with self._records_lock:
+            by_state: dict[str, int] = {}
+            for r in self._records.values():
+                by_state[r.state] = by_state.get(r.state, 0) + 1
+        return {
+            "workers": self.cfg.workers,
+            "states": by_state,
+            "terminal": self._terminal_count,
+            "stopping": self._stop.is_set(),
+        }
+
+    # ---------------------------------------------------------- dispatcher
+    def _scan_pending(self, now: float) -> list[tuple[tuple, Path, dict]]:
+        """Eligible pending messages with their admission sort key."""
+        out = []
+        with self._records_lock:
+            inflight = dict(self._inflight_by_tenant)
+        for p in sorted(self.root.glob("pending/*.json")):
+            try:
+                msg = json.loads(p.read_text())
+                if not isinstance(msg, dict):
+                    msg = {}
+            except FileNotFoundError:
+                continue              # claimed by another scheduler mid-scan
+            except (OSError, json.JSONDecodeError):
+                # poison payload — still admitted; claim+run dead-letters it
+                msg = {}
+            svc = msg.get("service", {})
+            if float(svc.get("next_retry_at", 0.0)) > now:
+                continue              # backoff not elapsed yet
+            tenant = str(msg.get("tenant", "default"))
+            rank = _priority_rank(msg.get("priority", "normal"))
+            published = float(msg.get("published_at", 0.0))
+            key = (rank, inflight.get(tenant, 0), published, p.name)
+            out.append((key, p, msg))
+        out.sort(key=lambda t: t[0])
+        return out
+
+    def _claim(self, p: Path) -> Path | None:
+        dst = self.root / "running" / p.name
+        try:
+            os.replace(p, dst)        # atomic claim (same as QueueConsumer)
+            return dst
+        except FileNotFoundError:
+            return None               # another scheduler/daemon won the race
+
+    def _dispatch_loop(self) -> None:
+        while not self._stop.is_set():
+            admitted = self._admit_one()
+            if not admitted:
+                self._stop.wait(self.cfg.poll_interval_s)
+        self._drain_handoff()
+        self._drained.set()
+
+    def _admit_one(self) -> bool:
+        """Claim and hand off the single best eligible message, then return
+        so the next admission re-scans with FRESH fairness keys (per-tenant
+        in-flight counts move with every claim)."""
+        for _key, p, msg in self._scan_pending(time.time()):
+            if self._stop.is_set():
+                return False
+            claimed = self._claim(p)
+            if claimed is None:
+                continue              # another scheduler/daemon won the race
+            msg_id = claimed.stem
+            rec = self._record(msg_id)
+            rec.ds_id = str(msg.get("ds_id", ""))
+            rec.tenant = str(msg.get("tenant", "default"))
+            rec.priority = msg.get("priority", "normal")
+            rec.published_at = float(msg.get("published_at", 0.0))
+            rec.attempts = int(msg.get("service", {}).get("attempts", 0))
+            rec.state = "claimed"
+            rec.claimed_at = time.time()
+            with self._records_lock:
+                self._inflight_by_tenant[rec.tenant] = (
+                    self._inflight_by_tenant.get(rec.tenant, 0) + 1)
+            # blocks when all workers are busy and the hand-off buffer is
+            # full — natural admission backpressure
+            while not self._stop.is_set():
+                try:
+                    self._handoff.put((claimed, msg), timeout=0.2)
+                    return True
+                except _queue_mod.Full:
+                    continue
+            self._requeue_unstarted(claimed, msg)
+            return False
+        return False
+
+    def _requeue_unstarted(self, claimed: Path, msg: dict) -> None:
+        rec = self._record(claimed.stem)
+        try:
+            os.replace(claimed, self.root / "pending" / claimed.name)
+        except FileNotFoundError:
+            return
+        clear_heartbeat(claimed)
+        rec.state = "queued"
+        with self._records_lock:
+            t = rec.tenant
+            self._inflight_by_tenant[t] = max(0, self._inflight_by_tenant.get(t, 1) - 1)
+        logger.info("scheduler: requeued claimed-but-unstarted %s", claimed.name)
+
+    def _drain_handoff(self) -> None:
+        """On shutdown: claimed-but-unstarted messages go back to pending/."""
+        while True:
+            try:
+                claimed, msg = self._handoff.get_nowait()
+            except _queue_mod.Empty:
+                return
+            self._requeue_unstarted(claimed, msg)
+
+    # -------------------------------------------------------------- worker
+    def _job_timeout_s(self, msg: dict) -> float:
+        svc = msg.get("service", {}) if isinstance(msg, dict) else {}
+        return float(svc.get("timeout_s", msg.get("timeout_s",
+                                                  self.cfg.job_timeout_s)))
+
+    def _job_max_attempts(self, msg: dict) -> int:
+        svc = msg.get("service", {}) if isinstance(msg, dict) else {}
+        return int(svc.get("max_attempts", msg.get("max_attempts",
+                                                   self.retry.max_attempts)))
+
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                claimed, msg = self._handoff.get(timeout=0.2)
+            except _queue_mod.Empty:
+                if self._stop.is_set() and self._drained.is_set():
+                    return
+                continue
+            try:
+                self._run_one(claimed, msg)
+            except Exception:        # never kill a worker thread
+                logger.error("scheduler: internal error running %s",
+                             claimed.name, exc_info=True)
+
+    def _run_one(self, claimed: Path, msg: dict) -> None:
+        msg_id = claimed.stem
+        rec = self._record(msg_id)
+        rec.state = "running"
+        rec.started_at = time.time()
+        rec.attempts += 1
+        if self.metrics:
+            self.m_running.inc()
+        hb = ClaimHeartbeat(claimed, interval_s=self.cfg.heartbeat_interval_s)
+        hb.start()
+        timed_out = False
+        try:
+            if not isinstance(msg, dict) or not msg:
+                # poison message (unparseable JSON): dead-letter immediately,
+                # keeping the raw payload as evidence (daemon contract)
+                raw = ""
+                try:
+                    raw = claimed.read_text()
+                    msg = json.loads(raw)
+                except (OSError, json.JSONDecodeError) as exc:
+                    self._dead_letter(claimed, {"raw": raw}, rec,
+                                      f"poison message: {exc}", "")
+                    return
+            ctx = JobContext(msg_id=msg_id, attempt=rec.attempts,
+                             device_token=self.device_token,
+                             metrics=self.metrics)
+            attempt = _Attempt(self.callback, msg, ctx, self._cb_takes_ctx)
+            t0 = time.perf_counter()
+            attempt.start()
+            attempt.join(timeout=self._job_timeout_s(msg))
+            dt = time.perf_counter() - t0
+            if self.metrics:
+                self.m_duration.observe(dt)
+            if attempt.is_alive():
+                timed_out = True
+                if self.metrics:
+                    self.m_timeouts.inc()
+                self._handle_failure(
+                    claimed, msg, rec,
+                    f"timeout: attempt {rec.attempts} exceeded "
+                    f"{self._job_timeout_s(msg):.1f}s (abandoned)", "")
+            elif attempt.error is not None:
+                self._handle_failure(claimed, msg, rec,
+                                     str(attempt.error), attempt.tb)
+            else:
+                self._finish(claimed, rec)
+        finally:
+            if timed_out:
+                # the zombie attempt must not keep refreshing the heartbeat
+                hb.stop()
+            else:
+                hb.stop()
+            if self.metrics:
+                self.m_running.dec()
+            with self._records_lock:
+                t = rec.tenant
+                self._inflight_by_tenant[t] = max(
+                    0, self._inflight_by_tenant.get(t, 1) - 1)
+
+    def _finish(self, claimed: Path, rec: JobRecord) -> None:
+        os.replace(claimed, self.root / "done" / claimed.name)
+        clear_heartbeat(claimed)
+        rec.state = "done"
+        rec.finished_at = time.time()
+        with self._records_lock:
+            self._terminal_count += 1
+        if self.metrics:
+            self.m_jobs.labels(state="done").inc()
+        logger.info("scheduler: %s done (attempt %d)", claimed.name, rec.attempts)
+
+    def _handle_failure(self, claimed: Path, msg: dict, rec: JobRecord,
+                        error: str, tb: str) -> None:
+        max_attempts = self._job_max_attempts(msg)
+        rec.error = error
+        if rec.attempts >= max_attempts:
+            self._dead_letter(claimed, msg, rec, error, tb)
+            return
+        delay = self.retry.backoff_s(rec.attempts)
+        rec.state = "retry_wait"
+        rec.next_retry_at = time.time() + delay
+        if self.metrics:
+            self.m_retries.inc()
+            self.m_backoff.observe(delay)
+        # persist retry state INTO the message, then atomically republish:
+        # a scheduler crash here leaves either the old running/ copy (crash
+        # recovery requeues it) or the updated pending/ copy — never neither
+        updated = dict(msg)
+        svc = dict(updated.get("service", {}))
+        svc["attempts"] = rec.attempts
+        svc["next_retry_at"] = rec.next_retry_at
+        svc["last_error"] = error
+        updated["service"] = svc
+        tmp = self.root / "pending" / f".{claimed.name}.tmp"
+        tmp.write_text(json.dumps(updated, indent=2))
+        os.replace(tmp, self.root / "pending" / claimed.name)
+        claimed.unlink()
+        clear_heartbeat(claimed)
+        logger.warning(
+            "scheduler: %s attempt %d/%d failed (%s); retry in %.2fs",
+            claimed.name, rec.attempts, max_attempts, error, delay)
+
+    def _dead_letter(self, claimed: Path, msg: dict, rec: JobRecord,
+                     error: str, tb: str) -> None:
+        failed = dict(msg) if msg else {}
+        failed["error"] = error
+        if tb:
+            failed["traceback"] = tb
+        failed["attempts"] = rec.attempts
+        (self.root / "failed" / claimed.name).write_text(
+            json.dumps(failed, indent=2))
+        try:
+            claimed.unlink()
+        except FileNotFoundError:
+            pass
+        clear_heartbeat(claimed)
+        rec.state = "failed"
+        rec.finished_at = time.time()
+        with self._records_lock:
+            self._terminal_count += 1
+        if self.metrics:
+            self.m_jobs.labels(state="failed").inc()
+        logger.error("scheduler: %s dead-lettered after %d attempt(s): %s",
+                     claimed.name, rec.attempts, error)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._started:
+            raise RuntimeError("scheduler already started")
+        self._started = True
+        # crash recovery first: claims with dead heartbeats return to pending
+        n = self.requeue_stale()
+        if n:
+            logger.info("scheduler: requeued %d stale claim(s) on startup", n)
+        d = threading.Thread(target=self._dispatch_loop, daemon=True,
+                             name="sched-dispatch")
+        d.start()
+        self._threads.append(d)
+        for i in range(self.cfg.workers):
+            w = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"sched-worker-{i}")
+            w.start()
+            self._threads.append(w)
+        logger.info("scheduler: started (%d workers, queue %s)",
+                    self.cfg.workers, self.root)
+
+    def requeue_stale(self) -> int:
+        """Heartbeat-aware crash recovery (delegates to the daemon's)."""
+        from ..engine.daemon import QueueConsumer
+
+        consumer = QueueConsumer(self.root.parent, callback=None,
+                                 queue=self.root.name)
+        return consumer.requeue_stale(max_age_s=self.cfg.stale_after_s)
+
+    def shutdown(self, timeout_s: float | None = None) -> bool:
+        """Graceful drain: stop admission, requeue claimed-but-unstarted,
+        wait for running jobs.  Returns True when fully drained in time."""
+        timeout_s = self.cfg.drain_timeout_s if timeout_s is None else timeout_s
+        self._stop.set()
+        deadline = time.time() + timeout_s
+        ok = True
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.time()))
+            ok = ok and not t.is_alive()
+        # belt and braces: anything still claimed (worker died mid-move)
+        self._drain_handoff()
+        logger.info("scheduler: shutdown %s", "clean" if ok else "TIMED OUT")
+        return ok
+
+    def wait_for_terminal(self, n: int, timeout_s: float = 60.0) -> bool:
+        """Block until ``n`` jobs reached a terminal state (tests/smoke)."""
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if self._terminal_count >= n:
+                return True
+            time.sleep(0.02)
+        return self._terminal_count >= n

@@ -1,0 +1,135 @@
+"""AnnotationService — the long-running serving composition.
+
+Wires together the spool (``QueuePublisher`` for ``POST /submit``), the
+``JobScheduler`` worker pool, the metrics registry (phase-timer observer +
+residency collector + spool depth gauges), and the ``AdminAPI``, with
+POSIX-graceful shutdown: SIGTERM/SIGINT stop admission, requeue
+claimed-but-unstarted messages, drain running jobs, then stop the API —
+``running/`` is empty on a clean exit, so a restart resumes exactly the
+pending backlog.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+import time
+from pathlib import Path
+
+from ..engine.daemon import QUEUE_ANNOTATE, QueuePublisher, _STATES
+from ..utils.config import SMConfig
+from ..utils.logger import logger, set_phase_observer
+from .api import AdminAPI
+from .metrics import MetricsRegistry
+from .scheduler import JobScheduler
+
+
+class AnnotationService:
+    def __init__(
+        self,
+        queue_dir: str | Path,
+        callback,
+        sm_config: SMConfig | None = None,
+        queue: str = QUEUE_ANNOTATE,
+        residency=None,
+        with_api: bool = True,
+    ):
+        self.sm_config = sm_config or SMConfig.get_conf()
+        cfg = self.sm_config.service
+        self.queue_dir = Path(queue_dir)
+        self.queue = queue
+        self.metrics = MetricsRegistry()
+        self.publisher = QueuePublisher(queue_dir, queue=queue)
+        self.scheduler = JobScheduler(
+            queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics)
+        self.residency = residency
+        self.started_at = time.time()
+        self._stop_requested = threading.Event()
+        self._phase_hist = self.metrics.histogram(
+            "sm_phase_seconds", "Pipeline phase wall clock by phase name",
+            ("phase",))
+        if residency is not None:
+            self.metrics.add_collector(self._collect_residency)
+        self.api = AdminAPI(self, host=cfg.http_host,
+                            port=cfg.http_port) if with_api else None
+
+    # -------------------------------------------------------------- metrics
+    def _observe_phase(self, phase: str, seconds: float) -> None:
+        self._phase_hist.labels(phase=phase).observe(seconds)
+
+    def _collect_residency(self, m: MetricsRegistry) -> None:
+        """Scrape-time pull of ``DatasetResidency.stats`` into counters
+        (the stats ARE cumulative, so exposing their current value under a
+        counter type is faithful)."""
+        stats = self.residency.stats
+        hits = m.counter("sm_residency_hits_total",
+                         "Residency cache hits", ("cache",))
+        misses = m.counter("sm_residency_misses_total",
+                           "Residency cache misses", ("cache",))
+        for cache in ("dataset", "backend"):
+            h = hits.labels(cache=cache)
+            miss = misses.labels(cache=cache)
+            # counters only move forward; set via delta from the live stats
+            h.inc(max(0.0, stats[f"{cache}_hits"] - h.value))
+            miss.inc(max(0.0, stats[f"{cache}_misses"] - miss.value))
+
+    def queue_depths(self) -> dict:
+        root = self.queue_dir / self.queue
+        return {s: len(list(root.glob(f"{s}/*.json"))) for s in _STATES}
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        set_phase_observer(self._observe_phase)
+        self.scheduler.start()
+        if self.api is not None:
+            self.api.start()
+        logger.info("service: up (queue=%s)", self.queue_dir / self.queue)
+
+    def shutdown(self, timeout_s: float | None = None) -> bool:
+        """Drain and stop everything; safe to call more than once."""
+        if self._stop_requested.is_set():
+            return True
+        self._stop_requested.set()
+        logger.info("service: shutdown requested — draining")
+        ok = self.scheduler.shutdown(timeout_s)
+        if self.api is not None:
+            self.api.stop()
+        set_phase_observer(None)
+        return ok
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT → graceful drain.  Only valid in the main thread."""
+
+        def _handler(signum, frame):
+            logger.info("service: received signal %d", signum)
+            # handler must return fast; the drain happens in a helper thread
+            threading.Thread(target=self.shutdown, daemon=True,
+                             name="signal-drain").start()
+
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+
+    def run_forever(self, max_terminal: int | None = None,
+                    idle_timeout_s: float | None = None) -> int:
+        """Block until shutdown (signal or programmatic).  ``max_terminal``
+        stops after N jobs reach a terminal state (smoke tests);
+        ``idle_timeout_s`` stops after the spool stays empty that long."""
+        idle_since = None
+        try:
+            while not self._stop_requested.is_set():
+                if max_terminal is not None and \
+                        self.scheduler._terminal_count >= max_terminal:
+                    break
+                if idle_timeout_s is not None:
+                    depths = self.queue_depths()
+                    busy = depths["pending"] or depths["running"]
+                    if busy:
+                        idle_since = None
+                    elif idle_since is None:
+                        idle_since = time.time()
+                    elif time.time() - idle_since >= idle_timeout_s:
+                        break
+                time.sleep(0.1)
+        finally:
+            self.shutdown()
+        return 0

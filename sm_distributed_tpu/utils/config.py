@@ -158,6 +158,35 @@ class ParallelConfig:
 
 
 @dataclass(frozen=True)
+class ServiceConfig:
+    """Annotation-service knobs (scheduler + failure policy + admin API) —
+    the serving-side analog of the reference's rabbitmq/daemon settings.
+    Consumed by ``sm_distributed_tpu.service`` (the ``serve`` CLI command)."""
+    workers: int = 2                     # concurrent job slots (CPU phases
+                                         # overlap; device phases serialize
+                                         # through the scheduler's TPU token)
+    poll_interval_s: float = 0.5         # pending/ scan cadence when idle
+    job_timeout_s: float = 21600.0       # per-attempt wall clock (6 h — the
+                                         # 80k-formula DESI job is 32-67 min)
+    max_attempts: int = 3                # attempts before dead-letter
+    backoff_base_s: float = 1.0          # retry delay = base * 2^(n-1) ...
+    backoff_max_s: float = 60.0          # ... capped here ...
+    backoff_jitter: float = 0.1          # ... times 1 + U[0, jitter]
+    heartbeat_interval_s: float = 5.0    # claim heartbeat touch cadence
+    stale_after_s: float = 30.0          # claims with no heartbeat this old
+                                         # are requeued by crash recovery
+    drain_timeout_s: float = 30.0        # graceful-shutdown wait for running
+    http_host: str = "127.0.0.1"         # admin API bind (healthz/metrics/
+    http_port: int = 8685                # jobs/submit); port 0 = ephemeral
+
+    def __post_init__(self):
+        if self.workers <= 0 or self.max_attempts <= 0:
+            raise ValueError("service: workers/max_attempts must be positive")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0 or self.backoff_jitter < 0:
+            raise ValueError("service: backoff knobs must be non-negative")
+
+
+@dataclass(frozen=True)
 class StorageConfig:
     """Replaces sm_config['db'/'elasticsearch'] service blocks: pluggable local
     sinks (parquet results + sqlite index) instead of Postgres/ES."""
@@ -174,6 +203,7 @@ class SMConfig:
     fdr: FDRConfig = field(default_factory=FDRConfig)
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
+    service: ServiceConfig = field(default_factory=ServiceConfig)
     work_dir: str = "/tmp/sm_tpu_work"
     logs_dir: str = ""                   # "" = console only
 
@@ -220,4 +250,5 @@ _DATACLASS_FIELDS = {
     ("SMConfig", "fdr"): FDRConfig,
     ("SMConfig", "parallel"): ParallelConfig,
     ("SMConfig", "storage"): StorageConfig,
+    ("SMConfig", "service"): ServiceConfig,
 }

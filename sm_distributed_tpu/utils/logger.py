@@ -35,6 +35,18 @@ def init_logger(logs_dir: str | None = None, level: int = logging.INFO) -> loggi
 
 logger = logging.getLogger(LOGGER_NAME)
 
+# Optional observer called as fn(phase, seconds) on every phase_timer exit.
+# The service layer installs one feeding its per-phase latency histogram
+# (sm_distributed_tpu.service.metrics) so /metrics sees every job's phases
+# without the engine importing the service.
+_phase_observer = None
+
+
+def set_phase_observer(fn) -> None:
+    """Install (or with ``None`` remove) the global phase-duration observer."""
+    global _phase_observer
+    _phase_observer = fn
+
 
 @contextlib.contextmanager
 def phase_timer(phase: str, timings: dict[str, float] | None = None):
@@ -49,3 +61,9 @@ def phase_timer(phase: str, timings: dict[str, float] | None = None):
         logger.info("phase %s done in %.3fs", phase, dt)
         if timings is not None:
             timings[phase] = timings.get(phase, 0.0) + dt
+        if _phase_observer is not None:
+            try:
+                _phase_observer(phase, dt)
+            except Exception:  # observability must never fail the pipeline
+                logger.warning("phase observer failed for %s", phase,
+                               exc_info=True)

@@ -28,13 +28,15 @@ def test_report_schema_and_values():
         "numpy_floor_spread", "numpy_floor_spread_mid5",
         "numpy_floor_n_ions", "floor_procs",
         "numpy_floor_multiproc_ions_per_s", "vs_baseline_multiproc",
-        "compile_s", "xla_cache_entries_before", "n_ions", "n_pixels",
-        "pixels_per_s", "isocalc_s",
+        "compile_s", "warmup_retried", "xla_cache_entries_before",
+        "n_ions", "n_pixels", "pixels_per_s", "isocalc_s",
     }
     assert out["value"] == 5000.0
     assert out["vs_baseline"] == 100.0
     assert out["jax_spread"] == 0.02
     assert out["compile_s"] == 12.0
+    # warmup_retried defaults False when absent and passes through when set
+    assert out["warmup_retried"] is False
     assert out["xla_cache_entries_before"] == 7
     assert out["numpy_floor_ions_per_s"] == 50.0
     assert out["numpy_floor_spread_mid5"] == 0.05
@@ -43,3 +45,21 @@ def test_report_schema_and_values():
     assert out["n_ions"] == 100 and out["n_pixels"] == 4096
     assert out["pixels_per_s"] == 5000.0 * 4096
     assert out["isocalc_s"] == 0.5
+
+
+def test_report_flags_retried_warmup():
+    prep, floor, jaxr = _fake_inputs()
+    jaxr["warmup_retried"] = True
+    assert report(prep, floor, jaxr)["warmup_retried"] is True
+
+
+def test_transient_warmup_error_matcher():
+    from bench import _is_transient_warmup_error
+
+    assert _is_transient_warmup_error(
+        RuntimeError("response body closed before all bytes were read"))
+    assert _is_transient_warmup_error(ConnectionResetError("Connection reset"))
+    # non-transient failures must NOT be retried (ADVICE r5)
+    assert not _is_transient_warmup_error(ValueError("bad formula_batch"))
+    assert not _is_transient_warmup_error(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory on TPU"))

@@ -1,0 +1,164 @@
+"""Queue crash-recovery tests (ISSUE 1 satellite): heartbeat-aware
+``requeue_stale`` and the two-consumer claim race on the shared spool."""
+
+import json
+import os
+import threading
+import time
+
+from sm_distributed_tpu.engine.daemon import (
+    ClaimHeartbeat,
+    QueueConsumer,
+    QueuePublisher,
+    heartbeat_path,
+    touch_heartbeat,
+)
+
+
+def _age(path, seconds):
+    old = time.time() - seconds
+    os.utime(path, (old, old))
+
+
+def test_requeue_stale_live_heartbeat_vs_dead_claim(tmp_path):
+    """A slow-but-alive claim (fresh heartbeat) must survive recovery; a
+    crashed claim (stale heartbeat) and a heartbeat-less claim older than
+    the threshold must be requeued."""
+    consumer = QueueConsumer(tmp_path / "q", callback=None)
+    root = tmp_path / "q" / "sm_annotate"
+
+    alive = root / "running" / "alive.json"
+    alive.write_text(json.dumps({"ds_id": "alive"}))
+    _age(alive, 600)                       # claimed long ago ...
+    touch_heartbeat(alive)                 # ... but its job is still beating
+
+    crashed = root / "running" / "crashed.json"
+    crashed.write_text(json.dumps({"ds_id": "crashed"}))
+    _age(crashed, 600)
+    touch_heartbeat(crashed)
+    _age(heartbeat_path(crashed), 600)     # heartbeat died with the process
+
+    no_hb = root / "running" / "no_hb.json"
+    no_hb.write_text(json.dumps({"ds_id": "no_hb"}))
+    _age(no_hb, 600)                       # pre-heartbeat-era claim
+
+    assert consumer.requeue_stale(max_age_s=30.0) == 2
+    assert sorted(p.name for p in root.glob("pending/*.json")) == [
+        "crashed.json", "no_hb.json"]
+    assert [p.name for p in root.glob("running/*.json")] == ["alive.json"]
+    # requeued claims carry no leftover heartbeat sidecars
+    assert not list(root.glob("pending/*.hb"))
+    assert not heartbeat_path(crashed).exists()
+
+    # once the live job's heartbeat goes stale too, it is recovered as well
+    _age(heartbeat_path(alive), 600)
+    assert consumer.requeue_stale(max_age_s=30.0) == 1
+    assert not list(root.glob("running/*.json"))
+
+    # default max_age_s=0 keeps the recover-everything cold-start behavior
+    fresh = root / "running" / "fresh.json"
+    fresh.write_text(json.dumps({"ds_id": "fresh"}))
+    assert consumer.requeue_stale() == 1
+
+
+def test_claim_heartbeat_thread_keeps_claim_alive(tmp_path):
+    consumer = QueueConsumer(tmp_path / "q", callback=None)
+    root = tmp_path / "q" / "sm_annotate"
+    msg = root / "running" / "beating.json"
+    msg.write_text(json.dumps({"ds_id": "b"}))
+    _age(msg, 600)
+    hb = ClaimHeartbeat(msg, interval_s=0.05)
+    hb.start()
+    try:
+        time.sleep(0.2)                    # several beats
+        assert consumer.requeue_stale(max_age_s=0.15) == 0, \
+            "live heartbeat was treated as stale"
+    finally:
+        hb.stop()
+    assert not heartbeat_path(msg).exists(), "stop() must clear the sidecar"
+    # with the heartbeat stopped the claim goes stale and is recovered
+    time.sleep(0.2)
+    assert consumer.requeue_stale(max_age_s=0.15) == 1
+
+
+def test_two_consumers_race_each_message_claimed_once(tmp_path):
+    """Publisher/consumer race: two consumers drain one spool concurrently;
+    every message is processed exactly once (the atomic-rename claim)."""
+    pub = QueuePublisher(tmp_path / "q")
+    n_msgs = 24
+    for i in range(n_msgs):
+        pub.publish({"ds_id": f"m{i:02d}", "input_path": "/in",
+                     "msg_id": f"m{i:02d}"})
+
+    seen: list[str] = []
+    lock = threading.Lock()
+
+    def make_cb(name):
+        def cb(msg):
+            with lock:
+                seen.append(msg["ds_id"])
+            time.sleep(0.001)          # widen the race window
+        return cb
+
+    consumers = [
+        QueueConsumer(tmp_path / "q", make_cb(f"c{k}"), poll_interval=0.01)
+        for k in range(2)
+    ]
+
+    def drain(c):
+        while c.process_one():
+            pass
+
+    threads = [threading.Thread(target=drain, args=(c,)) for c in consumers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+
+    assert sorted(seen) == [f"m{i:02d}" for i in range(n_msgs)], \
+        "a message was double-claimed or lost"
+    root = tmp_path / "q" / "sm_annotate"
+    assert len(list(root.glob("done/*.json"))) == n_msgs
+    assert not list(root.glob("pending/*.json"))
+    assert not list(root.glob("running/*.json"))
+
+
+def test_consumer_and_scheduler_share_one_spool(tmp_path):
+    """A legacy blocking consumer and the service scheduler can drain the
+    SAME queue concurrently without double-processing (mixed-fleet rollout:
+    old daemons and new service instances during a deploy)."""
+    from sm_distributed_tpu.service import JobScheduler
+    from sm_distributed_tpu.utils.config import ServiceConfig
+
+    pub = QueuePublisher(tmp_path / "q")
+    n_msgs = 16
+    for i in range(n_msgs):
+        pub.publish({"ds_id": f"x{i:02d}", "input_path": "/in",
+                     "msg_id": f"x{i:02d}"})
+    seen: list[str] = []
+    lock = threading.Lock()
+
+    def cb(msg, ctx=None):
+        with lock:
+            seen.append(msg["ds_id"])
+        time.sleep(0.002)
+
+    sched = JobScheduler(
+        tmp_path / "q", cb,
+        config=ServiceConfig(workers=2, poll_interval_s=0.01,
+                             backoff_base_s=0.05, http_port=0))
+    legacy = QueueConsumer(tmp_path / "q", cb, poll_interval=0.01)
+    sched.start()
+    t = threading.Thread(target=lambda: [legacy.process_one() or time.sleep(0.005)
+                                         for _ in range(200)])
+    t.start()
+    deadline = time.time() + 30.0
+    root = tmp_path / "q" / "sm_annotate"
+    while time.time() < deadline:
+        if len(list(root.glob("done/*.json"))) == n_msgs:
+            break
+        time.sleep(0.02)
+    t.join(timeout=30.0)
+    assert sched.shutdown()
+    assert sorted(seen) == [f"x{i:02d}" for i in range(n_msgs)]
+    assert len(list(root.glob("done/*.json"))) == n_msgs
