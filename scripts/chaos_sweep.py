@@ -1,0 +1,476 @@
+#!/usr/bin/env python
+"""Crash-recovery chaos sweep (ISSUE 2 tentpole).
+
+Runs the synthetic spheroid fixture end-to-end through the real spool +
+scheduler + SearchJob stack, then — for every registered failpoint
+(``sm_distributed_tpu/utils/failpoints.py``) — re-runs it with that fault
+injected (hard crash, torn write, typed error), restarts, and asserts the
+recovery invariants:
+
+- final annotations + all-metrics equal the fault-free golden report
+- the job's spool message is neither lost nor duplicated (exactly one copy,
+  in ``done/``)
+- the sqlite ledger is consistent (no orphaned STARTED rows; newest job
+  FINISHED)
+- zero tmp/part/heartbeat debris anywhere under the queue, results, and
+  work directories, and zero leftover checkpoint shards
+
+Usage::
+
+    python scripts/chaos_sweep.py                # full sweep, every failpoint
+    python scripts/chaos_sweep.py --smoke        # 3-scenario CI subset
+    python scripts/chaos_sweep.py --only ckpt.shard_write,spool.complete
+    python scripts/chaos_sweep.py --list         # registered failpoints
+    python scripts/chaos_sweep.py --check-docs   # names unique, documented
+                                                 # (docs/RECOVERY.md), covered
+
+Internal subcommands (the sweep's crashable subprocesses):
+``--consume-one QUEUE_DIR SM_CONFIG`` drains one job through a JobScheduler;
+``--publish-one QUEUE_DIR MSG_JSON`` publishes one message.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+# import every module hosting an injection seam so the registry is complete
+import sm_distributed_tpu.io.imzml  # noqa: F401,E402
+import sm_distributed_tpu.models.msm_basic  # noqa: F401,E402
+import sm_distributed_tpu.service.scheduler  # noqa: F401,E402
+from sm_distributed_tpu.engine.daemon import (  # noqa: E402
+    QUEUE_ANNOTATE,
+    QueueConsumer,
+    QueuePublisher,
+    _STATES,
+)
+from sm_distributed_tpu.engine.storage import JobLedger  # noqa: E402
+from sm_distributed_tpu.io.fixtures import (  # noqa: E402
+    FIXTURE_FORMULAS,
+    generate_synthetic_dataset,
+)
+from sm_distributed_tpu.utils import failpoints  # noqa: E402
+
+CRASH_RC = 21                 # failpoints' default os._exit code
+DS_ID = "chaos"
+MSG_ID = "chaosmsg"
+MAX_RUNS = 6                  # fault run + recovery attempts per scenario
+
+# fixture + engine shaping: small enough that a scenario is seconds, batched
+# enough that checkpoint groups, resume, and mid-search faults are real
+FIXTURE = dict(nrows=12, ncols=12, formulas=FIXTURE_FORMULAS[:8],
+               present_fraction=0.6, noise_peaks=40, mz_jitter_ppm=0.5, seed=7)
+SM_TEMPLATE = {
+    "backend": "numpy_ref",
+    "fdr": {"decoy_sample_size": 8, "seed": 42},
+    "parallel": {"formula_batch": 16, "checkpoint_every": 2,
+                 "resident_datasets": 0, "order_ions": "table"},
+    "storage": {"store_images": False},
+    "service": {"workers": 1, "poll_interval_s": 0.05, "job_timeout_s": 60.0,
+                "max_attempts": 3, "backoff_base_s": 0.05,
+                "backoff_max_s": 0.2, "backoff_jitter": 0.05,
+                "heartbeat_interval_s": 0.2, "stale_after_s": 1.0,
+                "drain_timeout_s": 10.0, "http_port": 0},
+}
+
+
+@dataclass
+class Scenario:
+    """One chaos experiment: inject ``spec`` (SM_FAILPOINTS grammar; may arm
+    several failpoints to reach a deep seam), crash/fail, restart, converge.
+    ``primary`` names the failpoint under test."""
+
+    primary: str
+    phase: str                # "consume" (fault in the worker) | "publish"
+    spec: str
+    note: str = ""
+    # how many consume runs carry the fault env: seams that only execute on
+    # RESTART (checkpoint resume) need the fault still armed after the first
+    # crash; later runs are always clean so every scenario can converge
+    spec_runs: int = 1
+
+
+# Every registered failpoint has exactly one scenario (enforced by
+# --check-docs and the sweep preamble).  Comments say what each one proves.
+SCENARIOS: list[Scenario] = [
+    Scenario("io.imzml_parse", "consume", "io.imzml_parse=crash@1",
+             "crash mid-parse; restart requeues and re-reads"),
+    Scenario("io.ibd_read", "consume", "io.ibd_read=crash@3",
+             "crash mid-ingest after some spectra"),
+    Scenario("workdir.fetch", "consume", "workdir.fetch=crash@2",
+             "crash between staged files; per-file resume refetches the rest"),
+    Scenario("workdir.stage_rename", "consume", "workdir.stage_rename=torn@1",
+             "torn fetch; size verify rejects it and the retry refetches"),
+    Scenario("ckpt.shard_write", "consume",
+             "ckpt.shard_write=torn@1;device.score_batch=crash@3",
+             "torn committed shard; resume detects the checksum and recomputes"),
+    Scenario("ckpt.shard_load", "consume",
+             "device.score_batch=crash@2;ckpt.shard_load=raise:OSError@1",
+             "shard read error on resume degrades to recompute, not a crash",
+             spec_runs=2),   # the load seam only runs on the restart
+    Scenario("device.score_batch", "consume", "device.score_batch=crash@2",
+             "device preemption mid-search; resume from the shard prefix"),
+    Scenario("storage.results_rename", "consume", "storage.results_rename=crash@1",
+             "crash before results commit; rerun sweeps the tmp debris"),
+    Scenario("storage.index_commit", "consume", "storage.index_commit=crash@1",
+             "crash inside the index replace; sqlite rolls back, rerun commits"),
+    Scenario("ledger.finish_job", "consume", "ledger.finish_job=crash@1",
+             "results durable but job row STARTED; idempotent rerun"),
+    Scenario("spool.publish_rename", "publish", "spool.publish_rename=crash@1",
+             "publisher dies pre-rename; orphan tmp swept, client republish"),
+    Scenario("spool.complete", "consume", "spool.complete=crash@1",
+             "job done but message stuck in running/; requeue + idempotent rerun"),
+    Scenario("spool.heartbeat", "consume", "spool.heartbeat=raise:OSError@1",
+             "heartbeat touch fails; claim survives and the job completes"),
+    Scenario("sched.retry_publish", "consume",
+             "device.score_batch=raise:RuntimeError@1;sched.retry_publish=crash@1",
+             "crash mid retry-republish; stale requeue recovers the claim"),
+]
+
+SMOKE = ("ckpt.shard_write", "spool.complete", "storage.results_rename")
+
+
+# --------------------------------------------------------------- subcommands
+def cmd_consume_one(queue_dir: str, sm_config_path: str) -> int:
+    """Drain one job through the real service scheduler (crashable)."""
+    from sm_distributed_tpu.engine.daemon import annotate_callback
+    from sm_distributed_tpu.service.scheduler import JobScheduler
+    from sm_distributed_tpu.utils.config import SMConfig
+
+    sm = SMConfig.set_path(sm_config_path)
+    sched = JobScheduler(queue_dir, annotate_callback(sm), config=sm.service)
+    sched.start()
+    ok = sched.wait_for_terminal(1, timeout_s=60.0)
+    sched.shutdown()
+    return 0 if ok else 3
+
+
+def cmd_publish_one(queue_dir: str, msg_path: str) -> int:
+    msg = json.loads(Path(msg_path).read_text())
+    QueuePublisher(queue_dir).publish(msg)
+    return 0
+
+
+# ------------------------------------------------------------------- driver
+def _sub_env(spec: str | None) -> dict:
+    env = dict(os.environ)
+    env.pop("SM_FAILPOINTS", None)
+    if spec:
+        env["SM_FAILPOINTS"] = spec
+    return env
+
+
+def _run_sub(args: list[str], spec: str | None) -> tuple[int, str]:
+    proc = subprocess.run(
+        [sys.executable, str(Path(__file__).resolve()), *args],
+        env=_sub_env(spec), capture_output=True, text=True, timeout=240,
+        cwd=str(REPO_ROOT))
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+@dataclass
+class Context:
+    """Per-scenario sandbox: its own spool, results, and work dirs."""
+
+    base: Path
+    msg: dict
+    sm_conf: Path = field(init=False)
+    queue_dir: Path = field(init=False)
+    root: Path = field(init=False)
+    results: Path = field(init=False)
+    work: Path = field(init=False)
+
+    def __post_init__(self):
+        self.queue_dir = self.base / "queue"
+        self.root = self.queue_dir / QUEUE_ANNOTATE
+        self.results = self.base / "results"
+        self.work = self.base / "work"
+        self.base.mkdir(parents=True, exist_ok=True)
+        sm = json.loads(json.dumps(SM_TEMPLATE))
+        sm["work_dir"] = str(self.work)
+        sm["storage"]["results_dir"] = str(self.results)
+        self.sm_conf = self.base / "sm.json"
+        self.sm_conf.write_text(json.dumps(sm, indent=2))
+
+    def done_msg(self) -> Path:
+        return self.root / "done" / f"{MSG_ID}.json"
+
+    def recover(self) -> None:
+        """What an operator/orchestrator does after a process death: requeue
+        dead claims, sweep orphan tmps, redrive dead letters, reconcile the
+        ledger.  Every step is also what the daemon does on startup, with the
+        age gates at zero because the crashed process is known dead."""
+        consumer = QueueConsumer(self.queue_dir, callback=None)
+        consumer.requeue_stale(max_age_s=0.0)
+        consumer.sweep_orphans(max_age_s=0.0)
+        for p in (self.root / "failed").glob("*.json"):
+            msg = json.loads(p.read_text())
+            for k in ("error", "traceback", "attempts", "service"):
+                msg.pop(k, None)
+            (self.root / "pending" / p.name).write_text(json.dumps(msg, indent=2))
+            p.unlink()
+        if (self.results / "engine.sqlite").exists():
+            ledger = JobLedger(self.results)
+            ledger.fail_stale_started(DS_ID)
+            ledger.close()
+
+
+def _read_report(results: Path) -> tuple:
+    import pandas as pd
+
+    out = []
+    for name in ("annotations.parquet", "all_metrics.parquet"):
+        df = pd.read_parquet(results / DS_ID / name)
+        out.append(df.sort_values(["sf", "adduct"]).reset_index(drop=True))
+    return tuple(out)
+
+
+def _assert_frames_equal(got, want, label: str, errs: list[str]) -> None:
+    import pandas as pd
+
+    try:
+        pd.testing.assert_frame_equal(got, want, rtol=1e-9, atol=1e-12)
+    except AssertionError as e:
+        errs.append(f"{label} differs from golden: {str(e).splitlines()[-1]}")
+
+
+def _debris(paths: list[Path]) -> list[str]:
+    out = []
+    for base in paths:
+        if not base.exists():
+            continue
+        for p in base.rglob("*"):
+            n = p.name
+            if ".tmp" in n or n.endswith((".part", ".hb")) or ".ckpt." in n:
+                out.append(str(p))
+    return out
+
+
+def check_invariants(ctx: Context, golden) -> list[str]:
+    errs: list[str] = []
+    msgs = {s: sorted(p.name for p in (ctx.root / s).glob("*.json"))
+            for s in _STATES}
+    total = sum(len(v) for v in msgs.values())
+    if msgs["done"] != [f"{MSG_ID}.json"] or total != 1:
+        errs.append(f"spool message lost/duplicated: {msgs}")
+    debris = _debris([ctx.root, ctx.results, ctx.work])
+    if debris:
+        errs.append(f"tmp/heartbeat/checkpoint debris: {debris}")
+    ledger = JobLedger(ctx.results)
+    try:
+        jobs = ledger.jobs(DS_ID)
+        if jobs.empty:
+            errs.append("ledger has no job rows")
+        else:
+            if (jobs.status == "STARTED").any():
+                errs.append(f"ledger kept STARTED rows: {jobs.status.tolist()}")
+            if jobs.iloc[-1].status != "FINISHED":
+                errs.append(f"newest job not FINISHED: {jobs.status.tolist()}")
+        idx_rows = ledger._conn.execute(
+            "SELECT COUNT(*) FROM annotation WHERE ds_id=?", (DS_ID,)).fetchone()[0]
+        if idx_rows != len(golden[0]):
+            errs.append(f"index has {idx_rows} rows, golden {len(golden[0])}")
+    finally:
+        ledger.close()
+    got = _read_report(ctx.results)
+    _assert_frames_equal(got[0], golden[0], "annotations", errs)
+    _assert_frames_equal(got[1], golden[1], "all_metrics", errs)
+    return errs
+
+
+def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
+                 verbose: bool = False) -> dict:
+    ctx = Context(base / sc.primary.replace(".", "_"), msg)
+    outputs: list[str] = []
+    result = {"scenario": sc.primary, "spec": sc.spec, "runs": 0, "ok": False}
+
+    if sc.phase == "publish":
+        msg_file = ctx.base / "msg.json"
+        msg_file.write_text(json.dumps(msg))
+        rc, out = _run_sub(
+            ["--publish-one", str(ctx.queue_dir), str(msg_file)], sc.spec)
+        outputs.append(out)
+        if rc != CRASH_RC:
+            result["error"] = f"publisher expected crash rc={CRASH_RC}, got {rc}"
+            return result
+        consumer = QueueConsumer(ctx.queue_dir, callback=None)
+        if consumer.sweep_orphans(max_age_s=0.0) < 1:
+            result["error"] = "crashed publish left no orphan tmp to sweep"
+            return result
+        QueuePublisher(ctx.queue_dir).publish(msg)   # the client's retry
+    else:
+        QueuePublisher(ctx.queue_dir).publish(msg)
+
+    while result["runs"] < MAX_RUNS:
+        armed = sc.phase == "consume" and result["runs"] < sc.spec_runs
+        spec = sc.spec if armed else None
+        rc, out = _run_sub(
+            ["--consume-one", str(ctx.queue_dir), str(ctx.sm_conf)], spec)
+        outputs.append(out)
+        result["runs"] += 1
+        if verbose:
+            print(f"  run {result['runs']}: rc={rc}")
+        if ctx.done_msg().exists():
+            break
+        ctx.recover()
+    else:
+        result["error"] = f"did not converge within {MAX_RUNS} runs"
+        result["output_tail"] = outputs[-1][-2000:]
+        return result
+
+    blob = "".join(outputs)
+    if f"FAILPOINT-FIRED name={sc.primary}" not in blob:
+        result["error"] = f"failpoint {sc.primary} never fired"
+        return result
+    # one final operator pass so crash-specific ledger rows are reconciled
+    ctx.recover()
+    errs = check_invariants(ctx, golden)
+    if errs:
+        result["error"] = "; ".join(errs)
+        result["output_tail"] = outputs[-1][-2000:]
+        return result
+    result["ok"] = True
+    return result
+
+
+def build_fixture(base: Path) -> dict:
+    fx_dir = base / "fixture"
+    imzml_path, truth = generate_synthetic_dataset(fx_dir, **FIXTURE)
+    return {
+        "ds_id": DS_ID, "ds_name": DS_ID, "msg_id": MSG_ID,
+        "input_path": str(imzml_path),
+        "formulas": truth.formulas,
+        "ds_config": {"isotope_generation": {"adducts": ["+H"]},
+                      "image_generation": {"ppm": 3.0}},
+    }
+
+
+def run_golden(base: Path, msg: dict):
+    ctx = Context(base / "golden", msg)
+    QueuePublisher(ctx.queue_dir).publish(msg)
+    rc, out = _run_sub(
+        ["--consume-one", str(ctx.queue_dir), str(ctx.sm_conf)], None)
+    if rc != 0 or not ctx.done_msg().exists():
+        raise RuntimeError(f"golden (fault-free) run failed rc={rc}:\n{out[-3000:]}")
+    return _read_report(ctx.results)
+
+
+def run_sweep(work: Path, only: list[str] | None = None,
+              verbose: bool = False) -> list[dict]:
+    os.environ.pop("SM_FAILPOINTS", None)   # the driver must never crash
+    failpoints.reset()
+    registered = set(failpoints.registered_failpoints())
+    primaries = {sc.primary for sc in SCENARIOS}
+    uncovered = registered - primaries
+    if uncovered:
+        raise RuntimeError(f"registered failpoints without a chaos scenario: "
+                           f"{sorted(uncovered)}")
+    scenarios = SCENARIOS if only is None else [
+        sc for sc in SCENARIOS if sc.primary in only]
+    if only is not None and len(scenarios) != len(only):
+        raise RuntimeError(f"unknown scenario names in {only}")
+    work.mkdir(parents=True, exist_ok=True)
+    msg = build_fixture(work)
+    t0 = time.time()
+    golden = run_golden(work, msg)
+    print(f"golden report: {len(golden[0])} annotations, "
+          f"{len(golden[1])} scored ions ({time.time() - t0:.1f}s)")
+    results = []
+    for sc in scenarios:
+        t0 = time.time()
+        r = run_scenario(sc, work, msg, golden, verbose=verbose)
+        r["seconds"] = round(time.time() - t0, 1)
+        status = "OK " if r["ok"] else "FAIL"
+        print(f"[{status}] {sc.primary:<24} runs={r['runs']} "
+              f"{r['seconds']:>5.1f}s  {sc.note}")
+        if not r["ok"]:
+            print(f"       spec: {sc.spec}\n       error: {r.get('error')}")
+            if verbose and r.get("output_tail"):
+                print(r["output_tail"])
+        results.append(r)
+    n_ok = sum(r["ok"] for r in results)
+    print(f"chaos sweep: {n_ok}/{len(results)} scenarios converged to golden")
+    return results
+
+
+# ---------------------------------------------------------------- doc check
+def check_docs(doc_path: Path | None = None) -> list[str]:
+    """Uniqueness is enforced at registration (duplicate register_failpoint
+    raises on import); here: every name documented + every name covered by a
+    scenario + every scenario name registered."""
+    doc_path = doc_path or REPO_ROOT / "docs" / "RECOVERY.md"
+    errs = []
+    registered = set(failpoints.registered_failpoints())
+    if not doc_path.exists():
+        return [f"missing {doc_path}"]
+    text = doc_path.read_text()
+    for name in sorted(registered):
+        if name not in text:
+            errs.append(f"failpoint {name} not documented in {doc_path.name}")
+    primaries = {sc.primary for sc in SCENARIOS}
+    for name in sorted(registered - primaries):
+        errs.append(f"failpoint {name} has no chaos scenario")
+    for name in sorted(primaries - registered):
+        errs.append(f"scenario {name} names an unregistered failpoint")
+    return errs
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--work", default=None,
+                    help="sweep directory (default: a fresh temp dir)")
+    ap.add_argument("--smoke", action="store_true",
+                    help=f"fast CI subset: {', '.join(SMOKE)}")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated scenario (failpoint) names")
+    ap.add_argument("--list", action="store_true", dest="list_fps")
+    ap.add_argument("--check-docs", action="store_true")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the sweep directory for inspection")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    ap.add_argument("--consume-one", nargs=2, metavar=("QUEUE_DIR", "SM_CONFIG"))
+    ap.add_argument("--publish-one", nargs=2, metavar=("QUEUE_DIR", "MSG_JSON"))
+    args = ap.parse_args(argv)
+
+    if args.consume_one:
+        return cmd_consume_one(*args.consume_one)
+    if args.publish_one:
+        return cmd_publish_one(*args.publish_one)
+    if args.list_fps:
+        for name, desc in sorted(failpoints.registered_failpoints().items()):
+            print(f"{name:<26} {desc}")
+        return 0
+    if args.check_docs:
+        errs = check_docs()
+        for e in errs:
+            print(f"check-docs: {e}", file=sys.stderr)
+        print(f"check-docs: {'FAIL' if errs else 'OK'} "
+              f"({len(failpoints.registered_failpoints())} failpoints)")
+        return 1 if errs else 0
+
+    only = list(SMOKE) if args.smoke else (
+        args.only.split(",") if args.only else None)
+    import shutil
+    import tempfile
+
+    work = Path(args.work) if args.work else Path(
+        tempfile.mkdtemp(prefix="sm_chaos_"))
+    try:
+        results = run_sweep(work, only=only, verbose=args.verbose)
+    finally:
+        if not args.keep and args.work is None:
+            shutil.rmtree(work, ignore_errors=True)
+    return 0 if all(r["ok"] for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,19 +3,39 @@
 # command and fails if DOTS_PASSED drops below the seed baseline, so test
 # regressions are caught mechanically instead of by eyeballing pytest output.
 #
-# Usage: scripts/check_tier1.sh [BASELINE]   (default baseline: 137)
+# Usage: scripts/check_tier1.sh [BASELINE] [--chaos]   (default baseline: 137)
 #
-# Exit codes: 0 = pass count >= baseline, 1 = regression or no count parsed.
+#   --chaos   also run the fast chaos smoke stage (3-failpoint subset of
+#             scripts/chaos_sweep.py) after the test gate (ISSUE 2 satellite)
+#
+# Always runs the failpoint registry gate first: registered names must be
+# unique (duplicate registration raises at import), documented in
+# docs/RECOVERY.md, and covered by a chaos scenario.
+#
+# Exit codes: 0 = all gates pass, 1 = regression / gate failure.
 # Note: pytest's own exit code is nonzero while the 32 pre-existing
 # failures/6 errors remain, so the GATE is the dots count, not pytest's rc.
 set -u -o pipefail
 
-BASELINE="${1:-137}"
+BASELINE="137"
+RUN_CHAOS=0
+for arg in "$@"; do
+    case "$arg" in
+        --chaos) RUN_CHAOS=1 ;;
+        *) BASELINE="$arg" ;;
+    esac
+done
 REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 LOG="$(mktemp /tmp/check_tier1.XXXXXX.log)"
 trap 'rm -f "$LOG"' EXIT
 
 cd "$REPO_ROOT"
+
+# failpoint registry gate (fast, catches undocumented/uncovered failpoints)
+if ! env JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --check-docs; then
+    echo "check_tier1: FAIL — failpoint registry check failed" >&2
+    exit 1
+fi
 
 # the ROADMAP.md tier-1 command, verbatim flags
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
@@ -36,3 +56,12 @@ if [ "$PASSED" -lt "$BASELINE" ]; then
     exit 1
 fi
 echo "check_tier1: OK — $PASSED passed >= baseline $BASELINE"
+
+if [ "$RUN_CHAOS" -eq 1 ]; then
+    echo "check_tier1: running chaos smoke stage (--chaos)"
+    if ! env JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --smoke; then
+        echo "check_tier1: FAIL — chaos smoke stage failed" >&2
+        exit 1
+    fi
+    echo "check_tier1: chaos smoke OK"
+fi

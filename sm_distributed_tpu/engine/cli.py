@@ -33,8 +33,16 @@ from ..utils.logger import init_logger, logger
 
 
 def _load_configs(args) -> SMConfig:
+    import os
+
     sm = SMConfig.set_path(args.sm_config) if args.sm_config else SMConfig.get_conf()
     init_logger(sm.logs_dir or None)
+    if sm.failpoints and not os.environ.get("SM_FAILPOINTS"):
+        # config-file activation (env always wins — it was applied at import)
+        from ..utils import failpoints
+
+        failpoints.configure(sm.failpoints)
+        logger.warning("fault injection ACTIVE from config: %s", sm.failpoints)
     return sm
 
 

@@ -31,10 +31,43 @@ import uuid
 from pathlib import Path
 
 from ..utils.config import DSConfig, SMConfig
+from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
 
 QUEUE_ANNOTATE = "sm_annotate"
 _STATES = ("pending", "running", "done", "failed")
+
+FP_PUBLISH_RENAME = register_failpoint(
+    "spool.publish_rename",
+    "between a publish's tmp write and its os.replace into pending/")
+FP_COMPLETE = register_failpoint(
+    "spool.complete",
+    "after a job succeeds, before its message moves running/ -> done/")
+FP_HEARTBEAT = register_failpoint(
+    "spool.heartbeat", "inside a claim's heartbeat touch (I/O error)")
+
+
+def sweep_orphan_tmp(queue_root: Path, max_age_s: float = 300.0) -> int:
+    """Remove orphaned publish/retry tmp files from ``pending/``.
+
+    A crash between a tmp write and its ``os.replace`` (publisher's
+    ``.{msg_id}.tmp``, scheduler retry's ``.{msg_id}.json.tmp``) leaks the
+    hidden tmp forever — no ``*.json`` glob ever sees it.  Age-gated so a
+    publish that is in flight RIGHT NOW is never swept; crash-recovery
+    callers that know the writers are dead pass ``max_age_s=0``."""
+    n = 0
+    now = time.time()
+    for p in (Path(queue_root) / "pending").glob(".*.tmp"):
+        try:
+            if now - p.stat().st_mtime >= max_age_s:
+                p.unlink()
+                n += 1
+        except FileNotFoundError:
+            continue                  # a concurrent sweep/publish won
+    if n:
+        record_recovery("spool.orphan_tmp", n)
+        logger.info("spool: swept %d orphaned pending tmp file(s)", n)
+    return n
 
 
 def heartbeat_path(msg_path: Path) -> Path:
@@ -47,6 +80,7 @@ def heartbeat_path(msg_path: Path) -> Path:
 
 def touch_heartbeat(msg_path: Path) -> None:
     hb = heartbeat_path(msg_path)
+    failpoint(FP_HEARTBEAT, path=hb)
     hb.touch()
     # mtime-based liveness: touch() alone may not advance mtime on coarse
     # filesystems, so force it
@@ -103,6 +137,7 @@ class QueuePublisher:
         tmp = self.root / "pending" / f".{msg_id}.tmp"
         dst = self.root / "pending" / f"{msg_id}.json"
         tmp.write_text(json.dumps(msg, indent=2))
+        failpoint(FP_PUBLISH_RENAME, path=tmp)
         os.replace(tmp, dst)          # atomic publish
         return dst
 
@@ -165,6 +200,7 @@ class QueueConsumer:
             if self.on_failure:
                 self.on_failure(msg, exc)
         else:
+            failpoint(FP_COMPLETE, path=claimed)
             os.replace(claimed, self.root / "done" / claimed.name)
             logger.info("queue: %s done", claimed.name)
             if self.on_success:
@@ -194,7 +230,14 @@ class QueueConsumer:
                 os.replace(p, self.root / "pending" / p.name)
                 clear_heartbeat(p)
                 n += 1
+        if n:
+            record_recovery("spool.requeue_stale", n)
         return n
+
+    def sweep_orphans(self, max_age_s: float = 300.0) -> int:
+        """Startup sweep for orphaned publish tmp files (see
+        ``sweep_orphan_tmp``)."""
+        return sweep_orphan_tmp(self.root, max_age_s=max_age_s)
 
     def run(self, max_messages: int | None = None) -> None:
         """Blocking consume loop (the reference's pika blocking consume [U])."""
@@ -256,8 +299,15 @@ def main(argv: list[str] | None = None) -> int:
     from ..utils.logger import init_logger
 
     init_logger(sm_config.logs_dir or None)
+    if sm_config.failpoints and not os.environ.get("SM_FAILPOINTS"):
+        from ..utils import failpoints
+
+        failpoints.configure(sm_config.failpoints)
+        logger.warning("fault injection ACTIVE from config: %s",
+                       sm_config.failpoints)
     consumer = QueueConsumer(args.queue_dir, annotate_callback(sm_config))
     consumer.requeue_stale()
+    consumer.sweep_orphans()
     consumer.run(max_messages=args.max_messages)
     return 0
 

@@ -28,7 +28,17 @@ from pathlib import Path
 import numpy as np
 import pandas as pd
 
+from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
+
+FP_RESULTS_RENAME = register_failpoint(
+    "storage.results_rename",
+    "between results tmp writes and their atomic renames into place")
+FP_INDEX_COMMIT = register_failpoint(
+    "storage.index_commit",
+    "inside the annotation index delete+insert, before the commit")
+FP_LEDGER_FINISH = register_failpoint(
+    "ledger.finish_job", "before the job row flips STARTED -> FINISHED")
 
 JOB_STARTED = "STARTED"
 JOB_FINISHED = "FINISHED"
@@ -72,11 +82,28 @@ class JobLedger:
     """Job/dataset status bookkeeping (reference: ``job``/``dataset`` rows in
     Postgres written by SearchJob [U])."""
 
+    # Concurrent scheduler workers each open their own connection to the one
+    # ledger file; without a busy timeout a writer collision dies instantly
+    # with "database is locked" (ISSUE 2 satellite).
+    BUSY_TIMEOUT_S = 30.0
+
     def __init__(self, results_dir: str | Path):
         self.root = Path(results_dir)
         self.root.mkdir(parents=True, exist_ok=True)
         self.db_path = self.root / "engine.sqlite"
-        self._conn = sqlite3.connect(self.db_path)
+        self._conn = sqlite3.connect(self.db_path, timeout=self.BUSY_TIMEOUT_S)
+        self._conn.execute(
+            f"PRAGMA busy_timeout={int(self.BUSY_TIMEOUT_S * 1000)}")
+        # WAL lets readers proceed under a writer (index replace vs /jobs
+        # queries); falls back gracefully where the filesystem can't do WAL
+        mode = self._conn.execute("PRAGMA journal_mode=WAL").fetchone()[0]
+        if str(mode).lower() != "wal":
+            logger.warning(
+                "ledger %s: journal_mode=WAL unavailable (got %r); "
+                "concurrent access falls back to rollback-journal locking",
+                self.db_path, mode)
+        else:
+            self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.executescript(_SCHEMA)
         self._conn.commit()
 
@@ -100,6 +127,7 @@ class JobLedger:
         return int(cur.lastrowid)
 
     def finish_job(self, job_id: int) -> None:
+        failpoint(FP_LEDGER_FINISH)
         self._conn.execute(
             "UPDATE job SET status=?, finished_at=? WHERE id=?",
             (JOB_FINISHED, time.time(), job_id),
@@ -118,6 +146,27 @@ class JobLedger:
             "SELECT status FROM job WHERE id=?", (job_id,)
         ).fetchone()
         return row[0] if row else None
+
+    def fail_stale_started(self, ds_id: str | None = None,
+                           error: str = "orphaned by process crash") -> int:
+        """Crash reconciliation: mark STARTED job rows FAILED.  A row stuck in
+        STARTED means the owning process died between start_job and its
+        terminal update — rerunning is idempotent, but the ledger must not
+        report a dead job as live forever.  Only call when no other process
+        can legitimately own a STARTED row (single-daemon recovery, chaos
+        sweeps); with ``ds_id`` the sweep is scoped to one dataset."""
+        q = "UPDATE job SET status=?, finished_at=?, error=? WHERE status=?"
+        args: list = [JOB_FAILED, time.time(), error, JOB_STARTED]
+        if ds_id is not None:
+            q += " AND ds_id=?"
+            args.append(ds_id)
+        cur = self._conn.execute(q, args)
+        self._conn.commit()
+        n = cur.rowcount if cur.rowcount and cur.rowcount > 0 else 0
+        if n:
+            record_recovery("ledger.stale_started")
+            logger.warning("ledger: marked %d orphaned STARTED job(s) FAILED", n)
+        return n
 
     def jobs(self, ds_id: str | None = None) -> pd.DataFrame:
         q = "SELECT * FROM job"
@@ -158,6 +207,10 @@ class AnnotationIndex:
             self._conn.executemany(
                 "INSERT INTO annotation VALUES(?,?,?,?,?,?,?,?,?,?,?)", rows
             )
+            # a crash HERE rolls the whole replace back on the next open —
+            # the previous job's rows stay queryable (the invariant the
+            # chaos sweep's storage.index_commit scenario checks)
+            failpoint(FP_INDEX_COMMIT)
         except Exception:
             self._conn.rollback()
             raise
@@ -240,6 +293,14 @@ class SearchResultsStore:
         index never references annotations that are not on disk.
         """
         d = self.ds_dir(ds_id)
+        # sweep tmp debris a crashed previous store left behind: the rerun
+        # overwrites the same names, but a FAILED-then-abandoned dataset
+        # must not leak .tmp files forever
+        stale = list(d.glob("*.tmp"))
+        for p in stale:
+            p.unlink(missing_ok=True)
+        if stale:
+            record_recovery("storage.stale_tmp")
         tmps = []
         for name, df in (("annotations.parquet", bundle.annotations),
                          ("all_metrics.parquet", bundle.all_metrics)):
@@ -249,6 +310,7 @@ class SearchResultsStore:
         tmp_t = d / "timings.json.tmp"
         tmp_t.write_text(json.dumps(bundle.timings, indent=2))
         tmps.append((tmp_t, d / "timings.json"))
+        failpoint(FP_RESULTS_RENAME, path=tmps[0][0])
         for tmp, dst in tmps:
             tmp.replace(dst)
         n = self.index.index_ds(ds_id, job_id, bundle.annotations, ion_mzs)

@@ -25,7 +25,14 @@ import json
 import shutil
 from pathlib import Path
 
+from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
+
+FP_FETCH = register_failpoint(
+    "workdir.fetch", "before fetching one staging file (remote I/O error)")
+FP_STAGE_RENAME = register_failpoint(
+    "workdir.stage_rename",
+    "between a file's .part fetch and its rename into input/ (torn fetch)")
 
 
 def sibling_ibd_names(filename: str) -> tuple[str, ...]:
@@ -236,10 +243,22 @@ class WorkDirManager:
         for rel, sig in listing.items():
             out = dst / rel
             if out.exists() and staged.get(rel) == sig:
+                record_recovery("workdir.resume_skip")
                 continue                     # survived a partial staging
             out.parent.mkdir(parents=True, exist_ok=True)
             tmp = out.with_name(out.name + ".part")
+            failpoint(FP_FETCH, path=tmp)
             fetcher.fetch_file(src, rel, tmp)
+            failpoint(FP_STAGE_RENAME, path=tmp)
+            # verify the byte count against the source listing BEFORE the
+            # rename commits it: a torn/partial fetch must never be recorded
+            # as current (the manifest would then skip it forever)
+            got = tmp.stat().st_size
+            if got != int(sig[0]):
+                tmp.unlink(missing_ok=True)
+                raise OSError(
+                    f"staging fetched {got} bytes for {rel}, source lists "
+                    f"{sig[0]} — torn or concurrent write, refusing to commit")
             tmp.replace(out)
             # commit per file: a crash mid-staging resumes from here
             staged[rel] = sig

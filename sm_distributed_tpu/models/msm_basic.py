@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import zlib
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -23,7 +24,17 @@ from ..ops.fdr import FDR, DecoyAssignment
 from ..ops.imager_np import SortedPeakView, extract_ion_images
 from ..ops.isocalc import IsocalcWrapper, IsotopePatternTable
 from ..utils.config import DSConfig, SMConfig
+from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger, phase_timer
+
+FP_SHARD_WRITE = register_failpoint(
+    "ckpt.shard_write",
+    "between a checkpoint shard's tmp savez and its os.replace (torn/crash)")
+FP_SHARD_LOAD = register_failpoint(
+    "ckpt.shard_load", "per-shard checkpoint read (I/O error on resume)")
+FP_DEVICE_SCORE = register_failpoint(
+    "device.score_batch",
+    "before scoring a batch group (TPU preemption / XLA failure mid-search)")
 
 
 def _slice_table(table: IsotopePatternTable, s: int, e: int) -> IsotopePatternTable:
@@ -157,33 +168,53 @@ class SearchCheckpoint:
     def load(self, metrics: np.ndarray, n_groups: int,
              row_ranges: list[tuple[int, int]]) -> int:
         """Restore ``metrics`` rows in place from the contiguous shard
-        prefix; return # of completed batch groups (0 if absent/stale)."""
+        prefix; return # of completed batch groups (0 if absent/stale).
+
+        A shard that is unreadable, truncated, shape-mismatched, or fails its
+        CRC32 checksum is treated as MISSING — the prefix ends there and the
+        groups recompute — never as fatal: a torn checkpoint write must
+        degrade to extra work, not crash the resume path."""
         done = 0
         for gi in range(n_groups):
             path = self._shard(gi)
             if not path.exists():
                 break
             try:
+                failpoint(FP_SHARD_LOAD, path=path)
                 with np.load(path, allow_pickle=False) as z:
                     if (str(z["fingerprint"]) != self.fingerprint
                             or int(z["n_groups"]) != n_groups):
-                        break
+                        break             # stale checkpoint — normal miss
                     s, e = row_ranges[gi]
                     rows = z["rows"]
                     if rows.shape != (e - s, metrics.shape[1]):
-                        break
+                        raise ValueError("shard row shape mismatch")
+                    # np.load happily returns rows from a zip whose payload
+                    # bytes were silently corrupted in place; the checksum
+                    # catches what the container format does not
+                    if int(z["checksum"]) != zlib.crc32(
+                            np.ascontiguousarray(rows).tobytes()):
+                        raise ValueError("shard checksum mismatch")
                     metrics[s:e] = rows
-            except Exception:
-                break  # unreadable/corrupt shard: trust only the prefix
+            except Exception as exc:
+                # unreadable/corrupt shard: trust only the prefix before it
+                record_recovery("ckpt.corrupt_shard")
+                logger.warning(
+                    "checkpoint shard %s rejected (%s); resuming from the "
+                    "%d-group prefix before it", path.name, exc, done)
+                break
             done = gi + 1
         return done
 
     def save(self, metrics: np.ndarray, gi: int, n_groups: int,
              row_ranges: list[tuple[int, int]]) -> None:
         s, e = row_ranges[gi]
+        rows = np.ascontiguousarray(metrics[s:e])
         tmp = self._shard(gi).with_suffix(".tmp.npz")  # same dir -> atomic
         np.savez(tmp, fingerprint=np.str_(self.fingerprint),
-                 rows=metrics[s:e], n_groups=n_groups)
+                 rows=rows, n_groups=n_groups,
+                 checksum=zlib.crc32(rows.tobytes()))
+        failpoint(FP_SHARD_WRITE, path=tmp)
         os.replace(tmp, self._shard(gi))
 
     def finalize(self) -> None:
@@ -367,6 +398,9 @@ class MSMBasicSearch:
             for gi, group in enumerate(groups):
                 if gi < done:
                     continue
+                # device-fault seam: a preempted TPU / failed XLA launch
+                # surfaces here, after `done` groups are already durable
+                failpoint(FP_DEVICE_SCORE)
                 # lazy slices: every backend exposes score_batches; the jax
                 # one pipelines (async-enqueues all batches in the group
                 # before syncing any), the numpy one consumes one at a time

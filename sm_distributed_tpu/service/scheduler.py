@@ -45,13 +45,20 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from ..engine.daemon import (
+    FP_COMPLETE,
     QUEUE_ANNOTATE,
     ClaimHeartbeat,
     _STATES,
     clear_heartbeat,
+    sweep_orphan_tmp,
 )
 from ..utils.config import ServiceConfig
+from ..utils.failpoints import failpoint, register_failpoint
 from ..utils.logger import logger
+
+FP_RETRY_PUBLISH = register_failpoint(
+    "sched.retry_publish",
+    "between a retry's updated tmp write and its republish into pending/")
 
 PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
 
@@ -436,6 +443,9 @@ class JobScheduler:
                     0, self._inflight_by_tenant.get(t, 1) - 1)
 
     def _finish(self, claimed: Path, rec: JobRecord) -> None:
+        # same seam as the daemon consumer's: job succeeded, message not yet
+        # in done/ — a crash here must reprocess idempotently, never lose it
+        failpoint(FP_COMPLETE, path=claimed)
         os.replace(claimed, self.root / "done" / claimed.name)
         clear_heartbeat(claimed)
         rec.state = "done"
@@ -470,6 +480,7 @@ class JobScheduler:
         updated["service"] = svc
         tmp = self.root / "pending" / f".{claimed.name}.tmp"
         tmp.write_text(json.dumps(updated, indent=2))
+        failpoint(FP_RETRY_PUBLISH, path=tmp)
         os.replace(tmp, self.root / "pending" / claimed.name)
         claimed.unlink()
         clear_heartbeat(claimed)
@@ -509,6 +520,9 @@ class JobScheduler:
         n = self.requeue_stale()
         if n:
             logger.info("scheduler: requeued %d stale claim(s) on startup", n)
+        # orphaned publish/retry tmp files older than the staleness horizon
+        # can have no live writer — the crash that leaked them also killed it
+        sweep_orphan_tmp(self.root, max_age_s=self.cfg.stale_after_s)
         d = threading.Thread(target=self._dispatch_loop, daemon=True,
                              name="sched-dispatch")
         d.start()

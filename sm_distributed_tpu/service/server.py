@@ -18,6 +18,7 @@ from pathlib import Path
 
 from ..engine.daemon import QUEUE_ANNOTATE, QueuePublisher, _STATES
 from ..utils.config import SMConfig
+from ..utils.failpoints import attach_metrics as attach_failpoint_metrics
 from ..utils.logger import logger, set_phase_observer
 from .api import AdminAPI
 from .metrics import MetricsRegistry
@@ -48,6 +49,9 @@ class AnnotationService:
         self._phase_hist = self.metrics.histogram(
             "sm_phase_seconds", "Pipeline phase wall clock by phase name",
             ("phase",))
+        # chaos observability: sm_failpoints_injected_total{name=} and
+        # sm_recovery_events_total{event=} surface on /metrics
+        attach_failpoint_metrics(self.metrics)
         if residency is not None:
             self.metrics.add_collector(self._collect_residency)
         self.api = AdminAPI(self, host=cfg.http_host,

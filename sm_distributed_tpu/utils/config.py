@@ -206,6 +206,10 @@ class SMConfig:
     service: ServiceConfig = field(default_factory=ServiceConfig)
     work_dir: str = "/tmp/sm_tpu_work"
     logs_dir: str = ""                   # "" = console only
+    # fault injection for chaos/recovery testing (utils/failpoints.py,
+    # docs/RECOVERY.md): same grammar as the SM_FAILPOINTS env var, which
+    # always wins when set; "" disables.  NEVER set in production configs.
+    failpoints: str = ""
 
     def __post_init__(self):
         if self.backend not in VALID_BACKENDS:

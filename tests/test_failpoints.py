@@ -1,0 +1,157 @@
+"""Unit tests for the failpoint fault-injection subsystem (ISSUE 2 tentpole):
+spec parsing, deterministic triggers, actions, env activation in a child
+process, and the zero-overhead disabled path."""
+
+import subprocess
+import sys
+
+import pytest
+
+from sm_distributed_tpu.utils import failpoints as fp
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+def test_parse_grammar_roundtrip():
+    specs = fp.parse_failpoints(
+        "storage.results_rename=crash@2; ckpt.shard_write=torn;"
+        "device.score_batch=raise:RuntimeError@3;a.b=sleep:0.25;"
+        "c.d=raise?0.5;e.f=torn:0.25@4")
+    assert specs["storage.results_rename"].action == "crash"
+    assert specs["storage.results_rename"].nth == 2
+    assert specs["ckpt.shard_write"].action == "torn"
+    assert specs["device.score_batch"].arg == "RuntimeError"
+    assert specs["a.b"].arg == "0.25"
+    assert specs["c.d"].prob == 0.5 and specs["c.d"].rng is not None
+    assert specs["e.f"].arg == "0.25" and specs["e.f"].nth == 4
+
+
+@pytest.mark.parametrize("bad", [
+    "x.y",                      # no action
+    "x.y=explode",              # unknown action
+    "x.y=raise:Exception",      # not in the allowlist
+    "x.y=sleep",                # missing seconds
+    "x.y=torn:1.5",             # fraction out of range
+    "x.y=crash@0",              # @N is 1-based
+    "x.y=raise?2.0",            # probability out of range
+    "x.y=raise;x.y=crash",      # duplicate name
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        fp.parse_failpoints(bad)
+
+
+def test_nth_hit_fires_exactly_once():
+    fp.configure("t.nth=raise@3")
+    fp.failpoint("t.nth")
+    fp.failpoint("t.nth")
+    with pytest.raises(fp.FailpointError):
+        fp.failpoint("t.nth")
+    for _ in range(5):                      # @N means the Nth hit ONLY
+        fp.failpoint("t.nth")
+    assert fp.injected_counts() == {"t.nth": 1}
+
+
+def test_raise_injects_the_named_type():
+    fp.configure("t.raise=raise:OSError")
+    with pytest.raises(OSError, match="injected failpoint t.raise"):
+        fp.failpoint("t.raise")
+
+
+def test_seeded_probability_is_deterministic(monkeypatch):
+    def schedule():
+        fp.configure("t.prob=raise?0.4")
+        fired = []
+        for i in range(50):
+            try:
+                fp.failpoint("t.prob")
+                fired.append(False)
+            except fp.FailpointError:
+                fired.append(True)
+        return fired
+
+    a, b = schedule(), schedule()
+    assert a == b, "same seed must replay the same fault schedule"
+    assert 5 < sum(a) < 45
+    monkeypatch.setenv("SM_FAILPOINTS_SEED", "12345")
+    assert schedule() != a, "a different seed gives a different schedule"
+
+
+def test_torn_truncates_and_continues(tmp_path):
+    f = tmp_path / "victim.bin"
+    f.write_bytes(b"x" * 1000)
+    fp.configure("t.torn=torn:0.25")
+    fp.failpoint("t.torn", path=f)          # must NOT raise
+    assert f.stat().st_size == 250
+    # torn with no path is a hard programming error at the seam
+    fp.configure("t.torn=torn")
+    with pytest.raises(fp.FailpointError, match="no path"):
+        fp.failpoint("t.torn")
+
+
+def test_disabled_is_inert_and_counts_nothing(tmp_path):
+    f = tmp_path / "untouched.bin"
+    f.write_bytes(b"x" * 10)
+    for _ in range(1000):
+        fp.failpoint("ckpt.shard_write", path=f)
+    assert f.stat().st_size == 10
+    assert fp.injected_counts() == {}
+
+
+def test_env_activation_crashes_child_process():
+    """SM_FAILPOINTS is read at import, so any spawned worker inherits the
+    fault; crash = os._exit with the spec'd code, skipping all cleanup."""
+    from pathlib import Path
+
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    code = ("from sm_distributed_tpu.utils.failpoints import failpoint\n"
+            "failpoint('x.y', path=None)\n"
+            "print('unreachable')\n")
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env={"SM_FAILPOINTS": "x.y=crash:7", "PATH": "/usr/bin:/bin",
+             "PYTHONPATH": repo_root},
+        capture_output=True, text=True, cwd=repo_root)
+    assert proc.returncode == 7
+    assert "FAILPOINT-FIRED name=x.y action=crash" in proc.stderr
+    assert "unreachable" not in proc.stdout
+
+
+def test_duplicate_registration_rejected():
+    name = "test.dup_probe"
+    fp.register_failpoint(name, "probe")
+    try:
+        with pytest.raises(ValueError, match="duplicate"):
+            fp.register_failpoint(name)
+    finally:
+        fp._registry.pop(name, None)
+
+
+def test_metrics_export_and_backfill():
+    from sm_distributed_tpu.service.metrics import MetricsRegistry
+
+    fp.configure("t.m=raise@1")
+    with pytest.raises(fp.FailpointError):
+        fp.failpoint("t.m")
+    fp.record_recovery("unit.recovery", 3)
+    reg = MetricsRegistry()
+    fp.attach_metrics(reg)                  # pre-attachment counts backfill
+    fp.record_recovery("unit.recovery")     # post-attachment increments live
+    text = reg.expose()
+    assert 'sm_failpoints_injected_total{name="t.m"} 1' in text
+    assert 'sm_recovery_events_total{event="unit.recovery"} 4' in text
+
+
+def test_every_registered_failpoint_is_documented_and_covered():
+    """The satellite check, runnable from pytest too: unique names (register
+    raises on duplicates at import), every name documented in
+    docs/RECOVERY.md, every name exercised by a chaos scenario."""
+    import scripts.chaos_sweep as cs
+
+    errs = cs.check_docs()
+    assert errs == [], "\n".join(errs)
