@@ -150,7 +150,33 @@ def prepare(cfg: BenchConfig, cache_dir: Path):
     )
     np_backend = NumpyBackend(ds, ds_config)
     return dict(ds=ds, ds_config=ds_config, table=table, batches=batches,
-                sub=sub, np_backend=np_backend, isocalc_dt=isocalc_dt)
+                sub=sub, np_backend=np_backend, isocalc_dt=isocalc_dt,
+                pairs=pairs, flags=flags)
+
+
+def measure_isocalc_cold(cfg: BenchConfig, prep: dict, n_procs: int,
+                         device: bool) -> dict:
+    """Cold-path generation throughput (ISSUE 3 pinned fields): regenerate
+    the case's full ion set with NO cache, through the production chunk
+    pipeline (pool + optional device blur), and report wall/workers/rate.
+    Runs after the floors (spawn-based: safe beside JAX either way)."""
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.logger import logger
+
+    calc = IsocalcWrapper(prep["ds_config"].isotope_generation,
+                          cache_dir=None, n_procs=n_procs,
+                          device_blur=device or None)
+    t0 = time.perf_counter()
+    calc.pattern_table(prep["pairs"], prep["flags"])
+    dt = time.perf_counter() - t0
+    stats = calc.last_stats
+    logger.info("[%s] cold isocalc: %d patterns in %.1fs -> %.1f patterns/s "
+                "(%d workers%s)", cfg.name, stats.get("cold_patterns", 0), dt,
+                stats.get("patterns_per_s", 0.0), stats.get("workers", 1),
+                ", device blur" if stats.get("device") else "")
+    return dict(isocalc_cold_s=dt,
+                isocalc_workers=stats.get("workers", 1),
+                patterns_per_s=stats.get("patterns_per_s", 0.0))
 
 
 def measure_floor(cfg: BenchConfig, prep: dict, n_procs: int) -> dict:
@@ -300,10 +326,13 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
                 "(spread %.1f%%)", cfg.name, jax_rate, 100 * jax_spread)
     return dict(jax_rate=jax_rate, compile_dt=compile_dt,
                 jax_spread=jax_spread, cache_entries=cache_entries,
-                warmup_retried=warmup_retried)
+                warmup_retried=warmup_retried,
+                warmup_skipped=bool(
+                    getattr(backend, "last_warmup_skipped", False)))
 
 
-def report(prep: dict, floor: dict, jaxr: dict) -> dict:
+def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None) -> dict:
+    iso = iso or {}
     return {
         "value": round(jaxr["jax_rate"], 2),
         "jax_spread": round(jaxr["jax_spread"], 4),
@@ -317,11 +346,18 @@ def report(prep: dict, floor: dict, jaxr: dict) -> dict:
         "vs_baseline_multiproc": round(jaxr["jax_rate"] / floor["mp_rate"], 2),
         "compile_s": round(jaxr["compile_dt"], 2),
         "warmup_retried": bool(jaxr.get("warmup_retried", False)),
+        "warmup_skipped": bool(jaxr.get("warmup_skipped", False)),
         "xla_cache_entries_before": jaxr["cache_entries"],
         "n_ions": int(prep["table"].n_ions),
         "n_pixels": int(prep["ds"].n_pixels),
         "pixels_per_s": round(jaxr["jax_rate"] * prep["ds"].n_pixels, 0),
         "isocalc_s": round(prep["isocalc_dt"], 2),
+        # ISSUE 3 pinned cold-path fields (None on cases that skip the cold
+        # regeneration — only the headline case pays for it by default)
+        "isocalc_cold_s": (round(iso["isocalc_cold_s"], 2)
+                           if iso else None),
+        "isocalc_workers": iso.get("isocalc_workers"),
+        "patterns_per_s": iso.get("patterns_per_s"),
     }
 
 
@@ -347,6 +383,11 @@ def main() -> None:
                     help="skip the 256x256/500-formula scale case")
     ap.add_argument("--skip-desi", action="store_true",
                     help="skip the 512x512 (262k px) DESI-scale case")
+    ap.add_argument("--skip-isocalc-cold", action="store_true",
+                    help="skip the headline case's cold isocalc regeneration")
+    ap.add_argument("--isocalc-device", action="store_true",
+                    help="route the cold isocalc measurement through the "
+                         "device blur->centroid stage (ops/isocalc_jax.py)")
     args = ap.parse_args()
 
     from sm_distributed_tpu.utils.logger import init_logger
@@ -381,15 +422,20 @@ def main() -> None:
             args.decoy_sample_size, big_reps, baseline_ions=300))
 
     # phase 1: all host-side prep + ALL floor measurements (fork-safe: no
-    # jax yet); phase 2: jax timings per config
+    # jax yet); phase 1.5: cold isocalc regeneration (spawn-based, and the
+    # device variant initializes jax — must come after the forked floors);
+    # phase 2: jax timings per config
     preps = [prepare(c, cache_dir) for c in configs]
     floors = [measure_floor(c, p, n_procs) for c, p in zip(configs, preps)]
+    iso_cold = (None if args.skip_isocalc_cold else
+                measure_isocalc_cold(configs[0], preps[0], n_procs,
+                                     args.isocalc_device))
     jaxrs = [measure_jax(c, p, cache_dir) for c, p in zip(configs, preps)]
 
     out = {
         "metric": "ions_scored_per_sec_per_chip",
         "unit": "ions/s",
-        **report(preps[0], floors[0], jaxrs[0]),
+        **report(preps[0], floors[0], jaxrs[0], iso_cold),
     }
     for cfg, p, f, j in zip(configs[1:], preps[1:], floors[1:], jaxrs[1:]):
         out[cfg.name] = report(p, f, j)

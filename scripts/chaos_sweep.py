@@ -97,6 +97,10 @@ class Scenario:
     # RESTART (checkpoint resume) need the fault still armed after the first
     # crash; later runs are always clean so every scenario can converge
     spec_runs: int = 1
+    # extra env for the subprocess (all runs): e.g. SM_ISOCALC_CHUNK so the
+    # 72-pair fixture generates in several chunks and mid-generation crashes
+    # leave a real shard prefix to resume from
+    env: dict = field(default_factory=dict)
 
 
 # Every registered failpoint has exactly one scenario (enforced by
@@ -134,6 +138,20 @@ SCENARIOS: list[Scenario] = [
     Scenario("sched.retry_publish", "consume",
              "device.score_batch=raise:RuntimeError@1;sched.retry_publish=crash@1",
              "crash mid retry-republish; stale requeue recovers the claim"),
+    # --- isocalc cold-path seams (ISSUE 3; chunked so faults land mid-run)
+    Scenario("isocalc.worker", "consume", "isocalc.worker=crash@2",
+             "crash mid pattern generation; the committed chunk-shard prefix "
+             "survives and the rerun resumes from it",
+             env={"SM_ISOCALC_CHUNK": "32"}),
+    Scenario("isocalc.shard_save", "consume",
+             "isocalc.shard_save=torn@1;isocalc.worker=crash@3",
+             "torn committed cache shard; the CRC rejects it on restart and "
+             "only that chunk recomputes",
+             env={"SM_ISOCALC_CHUNK": "32"}),
+    Scenario("isocalc.shard_load", "consume",
+             "isocalc.worker=crash@2;isocalc.shard_load=raise:OSError@1",
+             "cache shard read error degrades to recompute, not a crash",
+             spec_runs=2, env={"SM_ISOCALC_CHUNK": "32"}),
 ]
 
 SMOKE = ("ckpt.shard_write", "spool.complete", "storage.results_rename")
@@ -161,19 +179,22 @@ def cmd_publish_one(queue_dir: str, msg_path: str) -> int:
 
 
 # ------------------------------------------------------------------- driver
-def _sub_env(spec: str | None) -> dict:
+def _sub_env(spec: str | None, extra: dict | None = None) -> dict:
     env = dict(os.environ)
     env.pop("SM_FAILPOINTS", None)
     if spec:
         env["SM_FAILPOINTS"] = spec
+    if extra:
+        env.update(extra)
     return env
 
 
-def _run_sub(args: list[str], spec: str | None) -> tuple[int, str]:
+def _run_sub(args: list[str], spec: str | None,
+             extra_env: dict | None = None) -> tuple[int, str]:
     proc = subprocess.run(
         [sys.executable, str(Path(__file__).resolve()), *args],
-        env=_sub_env(spec), capture_output=True, text=True, timeout=240,
-        cwd=str(REPO_ROOT))
+        env=_sub_env(spec, extra_env), capture_output=True, text=True,
+        timeout=240, cwd=str(REPO_ROOT))
     return proc.returncode, proc.stdout + proc.stderr
 
 
@@ -297,7 +318,8 @@ def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
         msg_file = ctx.base / "msg.json"
         msg_file.write_text(json.dumps(msg))
         rc, out = _run_sub(
-            ["--publish-one", str(ctx.queue_dir), str(msg_file)], sc.spec)
+            ["--publish-one", str(ctx.queue_dir), str(msg_file)], sc.spec,
+            sc.env)
         outputs.append(out)
         if rc != CRASH_RC:
             result["error"] = f"publisher expected crash rc={CRASH_RC}, got {rc}"
@@ -314,7 +336,8 @@ def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
         armed = sc.phase == "consume" and result["runs"] < sc.spec_runs
         spec = sc.spec if armed else None
         rc, out = _run_sub(
-            ["--consume-one", str(ctx.queue_dir), str(ctx.sm_conf)], spec)
+            ["--consume-one", str(ctx.queue_dir), str(ctx.sm_conf)], spec,
+            sc.env)
         outputs.append(out)
         result["runs"] += 1
         if verbose:

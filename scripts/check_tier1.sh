@@ -10,7 +10,9 @@
 #
 # Always runs the failpoint registry gate first: registered names must be
 # unique (duplicate registration raises at import), documented in
-# docs/RECOVERY.md, and covered by a chaos scenario.
+# docs/RECOVERY.md, and covered by a chaos scenario.  Then the isocalc
+# parallel smoke gate (scripts/isocalc_smoke.py): a 2-worker spheroid run
+# must produce byte-identical cache shards vs the serial run.
 #
 # Exit codes: 0 = all gates pass, 1 = regression / gate failure.
 # Note: pytest's own exit code is nonzero while the 32 pre-existing
@@ -31,9 +33,17 @@ trap 'rm -f "$LOG"' EXIT
 
 cd "$REPO_ROOT"
 
-# failpoint registry gate (fast, catches undocumented/uncovered failpoints)
+# failpoint registry gate (fast, catches undocumented/uncovered failpoints —
+# including the ISSUE 3 isocalc.* seams)
 if ! env JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --check-docs; then
     echo "check_tier1: FAIL — failpoint registry check failed" >&2
+    exit 1
+fi
+
+# isocalc parallel smoke gate (ISSUE 3): 2-worker generation on the spheroid
+# fixture must merge to byte-identical cache shards vs the serial run
+if ! env JAX_PLATFORMS=cpu python scripts/isocalc_smoke.py; then
+    echo "check_tier1: FAIL — isocalc parallel smoke gate failed" >&2
     exit 1
 fi
 

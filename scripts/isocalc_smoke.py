@@ -1,0 +1,85 @@
+"""Isocalc parallel smoke gate (ISSUE 3 satellite, run by check_tier1.sh).
+
+Generates the spheroid-fixture ion set twice — serially and through a
+2-worker spawn pool with a small chunk size — and asserts the tentpole's
+core guarantee mechanically: identical table values AND byte-identical
+incremental cache shards (same filenames, same bytes).  Also proves a
+third, cache-warm run loads the shards instead of recomputing.
+
+Exit 0 = gate passes; 1 = any mismatch.  Runtime: a few seconds (spawn
+startup dominates).
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main() -> int:
+    import numpy as np
+
+    import sm_distributed_tpu.ops.isocalc as iso_mod
+    from sm_distributed_tpu.io.fixtures import FIXTURE_FORMULAS
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    cfg = IsotopeGenerationConfig(adducts=("+H",))
+    pairs = [(sf, a) for sf in FIXTURE_FORMULAS for a in ("+H", "+Na", "+K")]
+    iso_mod._PARALLEL_THRESHOLD = 8      # force the pool on this small set
+
+    with tempfile.TemporaryDirectory() as d_ser, \
+            tempfile.TemporaryDirectory() as d_par:
+        ser = IsocalcWrapper(cfg, cache_dir=d_ser, n_procs=1, chunk_size=16)
+        t_ser = ser.pattern_table(pairs)
+        par = IsocalcWrapper(cfg, cache_dir=d_par, n_procs=2, chunk_size=16)
+        t_par = par.pattern_table(pairs)
+
+        if par.last_stats.get("workers") != 2:
+            print(f"isocalc_smoke: FAIL — pool did not engage "
+                  f"({par.last_stats})", file=sys.stderr)
+            return 1
+        if t_ser.sfs != t_par.sfs or not (
+                np.array_equal(t_ser.mzs, t_par.mzs)
+                and np.array_equal(t_ser.ints, t_par.ints)
+                and np.array_equal(t_ser.n_valid, t_par.n_valid)):
+            print("isocalc_smoke: FAIL — parallel table != serial table",
+                  file=sys.stderr)
+            return 1
+
+        s_shards = sorted(p.name for p in Path(d_ser).glob("theor_peaks_*"))
+        p_shards = sorted(p.name for p in Path(d_par).glob("theor_peaks_*"))
+        if not s_shards or s_shards != p_shards:
+            print(f"isocalc_smoke: FAIL — shard sets differ: "
+                  f"{s_shards} vs {p_shards}", file=sys.stderr)
+            return 1
+        for name in s_shards:
+            if (Path(d_ser) / name).read_bytes() != (
+                    Path(d_par) / name).read_bytes():
+                print(f"isocalc_smoke: FAIL — shard {name} bytes differ",
+                      file=sys.stderr)
+                return 1
+
+        # warm reload: a third wrapper must serve every ion from the shards
+        warm = IsocalcWrapper(cfg, cache_dir=d_par)
+        if len(warm._cache) != t_ser.n_ions:
+            print(f"isocalc_smoke: FAIL — warm reload found "
+                  f"{len(warm._cache)}/{t_ser.n_ions} ions", file=sys.stderr)
+            return 1
+        t_warm = warm.pattern_table(pairs)
+        if warm.last_stats.get("cold_patterns", -1) != 0 or not (
+                np.array_equal(t_warm.mzs, t_ser.mzs)):
+            print("isocalc_smoke: FAIL — warm run recomputed or diverged",
+                  file=sys.stderr)
+            return 1
+
+    print(f"isocalc_smoke: OK — {t_ser.n_ions} ions, {len(s_shards)} shards "
+          f"byte-identical across serial/2-worker runs, warm reload clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,7 @@ import traceback
 from pathlib import Path
 
 from ..io.dataset import SpectralDataset
-from ..models.msm_basic import MSMBasicSearch, SearchResultsBundle
+from ..models.msm_basic import IsotopePrefetch, MSMBasicSearch, SearchResultsBundle
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger, phase_timer
 from .moldb import MolecularDB
@@ -60,6 +60,9 @@ class SearchJob:
         # serializes here while their staging/parse phases overlap
         self.device_token = device_token
         self.ledger = JobLedger(self.sm_config.storage.results_dir)
+        # generation stats of the last completed run (workers, patterns/s,
+        # device flag) — read by probes/benches (scripts/cold_path_bench.py)
+        self.last_isocalc_stats: dict = {}
         self.store = SearchResultsStore(
             self.ledger,
             store_images=self.sm_config.storage.store_images,
@@ -85,8 +88,18 @@ class SearchJob:
         logger.info("job %d started for ds %s (%s)", job_id, self.ds_id, self.ds_name)
         prof = None
         succeeded = False
+        prefetch = None
         try:
             timings: dict[str, float] = {}
+            # ISSUE 3 layer 3: isotope-pattern generation needs only the
+            # formula list + configs, and it dominates the cold path (94.5%
+            # of the BASELINE #3 wall) — start it FIRST, so staging + parse
+            # below overlap it instead of queueing behind it
+            formulas = self._load_formulas()
+            if self.sm_config.parallel.overlap_isocalc != "off":
+                prefetch = IsotopePrefetch(
+                    formulas, self.ds_config, self.sm_config,
+                    str(Path(self.sm_config.work_dir) / "isocalc_cache"))
             with phase_timer("stage_input", timings):
                 self.work_dir.copy_input_data(self.input_path)
             with phase_timer("read_dataset", timings):
@@ -95,7 +108,6 @@ class SearchJob:
                 "dataset %s: %dx%d px, %d spectra, %d peaks",
                 self.ds_id, ds.nrows, ds.ncols, ds.n_spectra, ds.n_peaks,
             )
-            formulas = self._load_formulas()
             if self.profile_dir:
                 import jax
 
@@ -114,8 +126,12 @@ class SearchJob:
                     isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),
                     checkpoint_dir=str(self.work_dir.path),
                     backend_cache=self.residency,
+                    prefetch=prefetch,
                 )
+                prefetch = None   # ownership passed: search() consumes/cancels
                 bundle = search.search()
+                if search.isocalc is not None:
+                    self.last_isocalc_stats = dict(search.isocalc.last_stats)
                 if prof:
                     import jax
 
@@ -153,6 +169,14 @@ class SearchJob:
             succeeded = True
             return bundle
         except Exception as exc:
+            if prefetch is not None:
+                # job died between prefetch start and search(): stop the
+                # background generation before reporting failure
+                try:
+                    prefetch.cancel()
+                except Exception:
+                    logger.warning("isotope prefetch cancel failed",
+                                   exc_info=True)
             if prof:
                 import jax
 

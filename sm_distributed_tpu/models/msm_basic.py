@@ -22,7 +22,11 @@ from ..io.dataset import SpectralDataset
 from ..ops import metrics_np
 from ..ops.fdr import FDR, DecoyAssignment
 from ..ops.imager_np import SortedPeakView, extract_ion_images
-from ..ops.isocalc import IsocalcWrapper, IsotopePatternTable
+from ..ops.isocalc import (
+    ISOCALC_PATTERN_VERSION,
+    IsocalcWrapper,
+    IsotopePatternTable,
+)
 from ..utils.config import DSConfig, SMConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger, phase_timer
@@ -138,6 +142,90 @@ def make_backend(name: str, ds: SpectralDataset, ds_config: DSConfig,
     raise ValueError(f"unknown backend {name!r}")
 
 
+def make_isocalc(ds_config: DSConfig, sm_config: SMConfig,
+                 cache_dir: str | None) -> IsocalcWrapper:
+    """IsocalcWrapper wired to the engine's parallel.* isocalc knobs."""
+    par = sm_config.parallel
+    return IsocalcWrapper(
+        ds_config.isotope_generation,
+        cache_dir=cache_dir,
+        n_procs=par.isocalc_workers or None,
+        # "on" forces the device stage; "off" leaves the decision to the
+        # SM_ISOCALC_DEVICE env (None), so ad-hoc probes can opt in without
+        # a config edit
+        device_blur=True if par.isocalc_device == "on" else None,
+        chunk_size=par.isocalc_chunk,
+    )
+
+
+class IsotopePrefetch:
+    """Background decoy selection + isotope-pattern generation (ISSUE 3
+    layer 3).  SearchJob starts this BEFORE staging/parsing the input, so
+    the dominant cold-path cost — pattern generation — overlaps the input
+    pipeline instead of following it.  Everything here depends only on the
+    formula list and configs, never on the dataset.
+
+    ``result()`` joins the setup thread (decoy sampling + cache-shard load +
+    stream start — the generation itself keeps running inside the returned
+    ``PatternStream``) and re-raises any setup failure.  ``cancel()`` tears
+    the stream down when the job dies before consuming it.
+    """
+
+    def __init__(self, formulas: list[str], ds_config: DSConfig,
+                 sm_config: SMConfig, cache_dir: str | None):
+        import threading
+
+        self.formulas = list(dict.fromkeys(formulas))
+        self.ds_config = ds_config
+        self.sm_config = sm_config
+        self.cache_dir = cache_dir
+        self.timings: dict[str, float] = {}
+        self.fdr: FDR | None = None
+        self.assignment: DecoyAssignment | None = None
+        self.isocalc: IsocalcWrapper | None = None
+        self.stream = None
+        self._error: BaseException | None = None
+        self._thread = threading.Thread(
+            target=self._run, name="isotope-prefetch", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        import time
+
+        try:
+            iso_cfg = self.ds_config.isotope_generation
+            fdr_cfg = self.sm_config.fdr
+            self.fdr = FDR(
+                decoy_sample_size=fdr_cfg.decoy_sample_size,
+                target_adducts=iso_cfg.adducts,
+                seed=fdr_cfg.seed,
+            )
+            t0 = time.perf_counter()
+            self.assignment = self.fdr.decoy_adduct_selection(self.formulas)
+            self.pairs, self.flags = self.assignment.all_ion_tuples(
+                self.formulas, iso_cfg.adducts)
+            self.timings["decoy_selection"] = time.perf_counter() - t0
+            # wrapper construction loads the cache shards (warm: seconds at
+            # 1.68M ions) — deliberately inside this thread too
+            self.isocalc = make_isocalc(
+                self.ds_config, self.sm_config, self.cache_dir)
+            self.stream = self.isocalc.stream_table(self.pairs, self.flags)
+        except BaseException as exc:  # noqa: BLE001 — result() re-raises
+            self._error = exc
+
+    def result(self):
+        """(fdr, assignment, stream) — blocks on setup only."""
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        return self.fdr, self.assignment, self.stream
+
+    def cancel(self) -> None:
+        self._thread.join()
+        if self.stream is not None:
+            self.stream.cancel()
+
+
 class SearchCheckpoint:
     """Mid-search checkpoint of scored metrics (SURVEY §5.4: the reference has
     only coarse resume — theor_peaks cache + work-dir skips [U]; at BASELINE
@@ -245,6 +333,7 @@ class MSMBasicSearch:
         isocalc_cache_dir: str | None = None,
         checkpoint_dir: str | None = None,
         backend_cache=None,
+        prefetch: IsotopePrefetch | None = None,
     ):
         self.ds = ds
         self.formulas = list(dict.fromkeys(formulas))  # dedup, keep order
@@ -255,9 +344,12 @@ class MSMBasicSearch:
         # backend across jobs when the search fingerprint + backend-shaping
         # knobs all match — the second job skips device transfer AND compile
         self.backend_cache = backend_cache
-        self.isocalc = IsocalcWrapper(
-            ds_config.isotope_generation, cache_dir=isocalc_cache_dir
-        )
+        # orchestrator-started generation (SearchJob overlap): decoys +
+        # isocalc already running — search() consumes its stream instead of
+        # starting one
+        self.prefetch = prefetch
+        self.isocalc = None if prefetch is not None else make_isocalc(
+            ds_config, self.sm_config, isocalc_cache_dir)
         # populated by search(); the orchestrator reads these to persist ion
         # images / m/z values for annotated ions (engine/search_job.py) —
         # last_backend lets the jax path export DEVICE images instead of
@@ -288,6 +380,33 @@ class MSMBasicSearch:
         h.update("\x00".join(table.sfs).encode())
         h.update("\x00".join(table.adducts).encode())
         h.update(np.ascontiguousarray(table.mzs).tobytes())
+        return h.hexdigest()
+
+    def _fingerprint_pairs(self, table: IsotopePatternTable) -> str:
+        """Checkpoint fingerprint computable BEFORE patterns exist (the
+        overlapped path scores leading groups while generation runs, so it
+        cannot hash the pattern m/z block like ``_fingerprint``).  Instead
+        of pattern bits it hashes what determines them: the exact ion list,
+        the isotope-generation params, and ``ISOCALC_PATTERN_VERSION`` —
+        which MUST be bumped when pattern math changes result bits, or a
+        stale checkpoint would resume against different patterns."""
+        img = self.ds_config.image_generation
+        par = self.sm_config.parallel
+        iso = self.ds_config.isotope_generation
+        h = hashlib.sha256()
+        h.update(repr((self.ds.nrows, self.ds.ncols, int(self.ds.n_peaks),
+                       img.ppm, img.nlevels, img.do_preprocessing, img.q,
+                       par.formula_batch, par.checkpoint_every)).encode())
+        stride = max(1, self.ds.mzs_flat.size // 65536)
+        h.update(np.ascontiguousarray(self.ds.mzs_flat[::stride]).tobytes())
+        h.update(np.ascontiguousarray(self.ds.ints_flat[::stride]).tobytes())
+        h.update(np.float64(
+            self.ds.ints_flat.sum(dtype=np.float64)).tobytes())
+        h.update("\x00".join(table.sfs).encode())
+        h.update("\x00".join(table.adducts).encode())
+        h.update(repr((iso.charge, iso.isocalc_sigma, iso.isocalc_pts_per_mz,
+                       iso.n_peaks, ISOCALC_PATTERN_VERSION,
+                       bool(self.isocalc.device_blur))).encode())
         return h.hexdigest()
 
     def _agree_resume_point(self, done: int) -> int:
@@ -324,28 +443,61 @@ class MSMBasicSearch:
                 all_metrics=pd.DataFrame(columns=self._ALL_COLUMNS),
             )
         iso_cfg = self.ds_config.isotope_generation
-        fdr = FDR(
-            decoy_sample_size=self.sm_config.fdr.decoy_sample_size,
-            target_adducts=iso_cfg.adducts,
-            seed=self.sm_config.fdr.seed,
-        )
-        with phase_timer("decoy_selection", timings):
-            assignment: DecoyAssignment = fdr.decoy_adduct_selection(self.formulas)
-            pairs, flags = assignment.all_ion_tuples(self.formulas, iso_cfg.adducts)
+        if self.prefetch is not None:
+            # SearchJob started decoys + generation before staging; by the
+            # time search() runs, the stream has been computing all along
+            fdr, assignment, stream = self.prefetch.result()
+            self.isocalc = self.prefetch.isocalc
+            timings.update(self.prefetch.timings)
+        else:
+            fdr = FDR(
+                decoy_sample_size=self.sm_config.fdr.decoy_sample_size,
+                target_adducts=iso_cfg.adducts,
+                seed=self.sm_config.fdr.seed,
+            )
+            with phase_timer("decoy_selection", timings):
+                assignment: DecoyAssignment = fdr.decoy_adduct_selection(
+                    self.formulas)
+                pairs, flags = assignment.all_ion_tuples(
+                    self.formulas, iso_cfg.adducts)
+            stream = self.isocalc.stream_table(pairs, flags)
+        try:
+            return self._score_and_rank(stream, fdr, assignment, timings)
+        except BaseException:
+            stream.cancel()
+            raise
+
+    def _score_and_rank(self, stream, fdr: FDR, assignment: DecoyAssignment,
+                        timings: dict[str, float]) -> SearchResultsBundle:
+        # Overlapped scoring (ISSUE 3 layer 3): with the host backend, the
+        # leading checkpoint groups score as soon as their pattern rows are
+        # published — generation and scoring run concurrently.  The device
+        # backend consumes the WHOLE table up front (window-union peak
+        # restriction + executable presizing), so it waits for the stream
+        # instead; its overlap is at the SearchJob level (staging/parse).
+        overlap = (self.sm_config.parallel.overlap_isocalc != "off"
+                   and self.sm_config.backend == "numpy_ref")
         with phase_timer("isotope_patterns", timings):
-            table = self.isocalc.pattern_table(pairs, flags)
-        # m/z-localized batch unions (see maybe_order_table): per-ion
-        # results are order-independent, so this only changes which
-        # extraction variant each batch's plan picks
-        table = maybe_order_table(
-            table, self.sm_config.parallel.order_ions,
-            self.sm_config.parallel.formula_batch)
+            if overlap:
+                table = stream.table_view()   # rows fill in as chunks land
+            else:
+                table = stream.result_table()
+                # m/z-localized batch unions (see maybe_order_table):
+                # per-ion results are order-independent, so this only
+                # changes which extraction variant each batch's plan picks
+                table = maybe_order_table(
+                    table, self.sm_config.parallel.order_ions,
+                    self.sm_config.parallel.formula_batch)
         self.last_table = table
         logger.info(
-            "scoring %d ions (%d targets, %d decoys) with backend=%s",
+            "scoring %d ions (%d targets, %d decoys) with backend=%s%s",
             table.n_ions, int(table.targets.sum()),
             int((~table.targets).sum()), self.sm_config.backend,
+            " (overlapping isocalc)" if overlap else "",
         )
+        fingerprint = (self._fingerprint_pairs(table) if overlap
+                       else self._fingerprint(table))
+
         def build():
             return make_backend(
                 self.sm_config.backend, self.ds, self.ds_config,
@@ -354,7 +506,7 @@ class MSMBasicSearch:
 
         if self.backend_cache is not None:
             par = self.sm_config.parallel
-            key = (self.sm_config.backend, self._fingerprint(table),
+            key = (self.sm_config.backend, fingerprint,
                    par.mz_chunk, par.pixels_axis, par.formulas_axis,
                    par.peak_compaction, par.band_slice, par.order_ions)
             backend = self.backend_cache.backend(key, build)
@@ -378,8 +530,7 @@ class MSMBasicSearch:
                 else:
                     pid = 0
                 ckpt = SearchCheckpoint(
-                    self.checkpoint_dir, self._fingerprint(table),
-                    process_id=pid)
+                    self.checkpoint_dir, fingerprint, process_id=pid)
                 row_ranges = [(g[0][0], g[-1][1]) for g in groups]
                 done = self._agree_resume_point(
                     ckpt.load(metrics, len(groups), row_ranges))
@@ -387,8 +538,15 @@ class MSMBasicSearch:
                     logger.info(
                         "resuming search from checkpoint: %d/%d batch groups "
                         "already scored", done, len(groups))
+            elif overlap:
+                # no checkpoint grain: publish/score per batch, so overlap
+                # still engages (the host backend consumes batches one at a
+                # time anyway)
+                groups, ckpt, done = [[sl] for sl in slices], None, 0
+                row_ranges = [sl for sl in slices]
             else:
                 groups, ckpt, done = [slices], None, 0
+                row_ranges = [(0, table.n_ions)] if slices else []
             if len(groups) > 1 and hasattr(backend, "presize"):
                 # per-group score_batches calls would otherwise pre-size
                 # static shapes per GROUP and recompile when a later group
@@ -398,6 +556,9 @@ class MSMBasicSearch:
             for gi, group in enumerate(groups):
                 if gi < done:
                     continue
+                if overlap:
+                    # block until this group's pattern rows are published
+                    stream.wait_rows(row_ranges[gi][1])
                 # device-fault seam: a preempted TPU / failed XLA launch
                 # surfaces here, after `done` groups are already durable
                 failpoint(FP_DEVICE_SCORE)
@@ -416,6 +577,11 @@ class MSMBasicSearch:
             # leftover checkpoint is harmless (fingerprint-guarded) and makes
             # an identical re-search skip scoring entirely.
             self.last_checkpoint = ckpt
+            if overlap:
+                # join generation (shard commits/compaction may trail the
+                # last row) and surface any late stream error before FDR
+                stream.result_table()
+        timings["isocalc_gen"] = stream.gen_seconds
         with phase_timer("fdr", timings):
             all_df = pd.DataFrame(
                 {

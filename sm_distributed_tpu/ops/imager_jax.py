@@ -678,3 +678,61 @@ def extract_images_mz_chunked(
     _, imgs = jax.lax.scan(chunk, None, (starts, r_lo_loc, r_hi_loc))
     imgs = imgs.reshape(-1, p)                         # (C*Wc, P) sorted order
     return jnp.take(imgs, inv, axis=0)                 # (W, P) input order
+
+
+# -- roofline cost model ------------------------------------------------------
+
+def fused_score_cost_model(
+    n_pixels: int,
+    resident_peaks: int,
+    n_ions: int,
+    max_peaks: int,
+    formula_batch: int,
+    nlevels: int = 30,
+    ordered: bool = True,
+) -> dict:
+    """Minimum-work estimate of one full scoring rep (all ions once), for
+    the roofline probe (scripts/roofline_probe.py, ISSUE 3 satellite).
+
+    Counts the traffic/flops the fused graph CANNOT avoid under its current
+    algorithm, priced from the extraction design (this module) and the
+    measured mechanism notes in docs/PERF.md:
+
+    - histogram scatter: every scored peak slot is one 4 B intensity read,
+      one index read, and one f32 read-modify-write on the scratch (~12 B).
+      Ordered streams scatter each resident peak ~once in total (band-slice
+      per-batch bands); unordered streams re-touch the residents per batch.
+    - scratch zero-init: XLA scatter's fixed cost is the operand
+      zero-init/copy (measured ~38 GB/s on v5e, PERF.md round 5) — one
+      (P+1) x max(G+1, gc+2) f32 block per batch.
+    - membership matmul: wh (P, G+1) @ D (G+1, B) per batch at f32.
+    - image block: (n_ions, K, P) f32 written by extraction, then read by
+      the moments pass (1x) and the chaos sweeps (>= ~2 effective passes of
+      the label plane at span-32 with the cheap certificate).
+
+    Returns bytes/flops totals; ``min_seconds(bw, flops)`` against measured
+    device peaks is the roofline floor.  This is a LOWER bound on work (it
+    prices no padding, no recompiles, no host/dispatch), so
+    measured/modeled is an upper bound on remaining headroom.
+    """
+    n_batches = max(1, -(-n_ions // formula_batch))
+    g = 2 * formula_batch * max_peaks
+    scratch_cols = max(g + 1, 4098)
+    scatter_slots = (resident_peaks if ordered
+                     else resident_peaks * n_batches)
+    scatter_bytes = 12 * scatter_slots
+    init_bytes = 4 * n_batches * (n_pixels + 1) * scratch_cols
+    image_bytes = 4 * n_ions * max_peaks * n_pixels
+    metric_read_bytes = 3 * image_bytes    # moments 1x + chaos ~2 passes
+    matmul_flops = 2.0 * n_batches * n_pixels * (g + 1) * formula_batch
+    total_bytes = scatter_bytes + init_bytes + image_bytes + metric_read_bytes
+    return dict(
+        n_batches=n_batches,
+        scatter_slots=int(scatter_slots),
+        scatter_bytes=int(scatter_bytes),
+        scratch_init_bytes=int(init_bytes),
+        image_bytes=int(image_bytes),
+        metric_read_bytes=int(metric_read_bytes),
+        total_bytes=int(total_bytes),
+        matmul_flops=float(matmul_flops),
+    )

@@ -1,0 +1,273 @@
+"""Batched device-side gaussian blur -> centroid detection (ISSUE 3, layer 2).
+
+The isotope cold path's post-convolution math — blur the fine structure at
+instrument sigma, find local maxima, parabolic-refine the top ``n_peaks`` —
+was 85%+ of host pattern cost and ran one tiny NumPy array at a time.  Here
+it runs VECTORIZED over a packed batch of fine-structure SEGMENTS in JAX.
+
+Formulation (why dense, not scatter): windowed fine-structure states cluster
+at isotope spacings (~1/|z| Da) while the blur support is only 5*sigma, so
+each ion's profile decomposes into <= n_peaks+4 short independent segments
+(``ops.isocalc.fine_structure_segments``).  Per segment the profile is
+evaluated densely::
+
+    profile[l] = sum_s ab[s] * exp(-((l*step - m_rel[s]) / sigma)^2 / 2)
+
+— one fused exp + einsum, no scatter.  Measured on this host (XLA CPU,
+single core): the literal scatter-add port of the oracle ran 5x SLOWER than
+NumPy (XLA CPU serializes scatter), while this dense form runs ~3x FASTER;
+on TPU the einsum maps to the MXU.
+
+Batching is over SEGMENTS, not ions: segments are flattened across the ion
+batch and grouped by their OWN state-count bucket, so a light 10-state
+segment never pays a heavy neighbor's padding (the first, ion-padded version
+of this kernel measured only 1.26x over the oracle on the decoy-adduct-heavy
+full-DB corpus because per-ion C_CAP x max-state padding wasted ~4x the exp
+work; packed segments recover it).  The per-batch row count scales inversely
+with the state bucket so the dense (B, LC, S) block stays ~50 MB.
+
+All device math is f32 in segment-local coordinates (range < 0.16 Da, so
+f32 carries ~1e-8 Da resolution); absolute m/z assembly, cross-segment
+top-k selection, and intensity normalization happen on host in f64
+(vectorized numpy, no per-ion Python loop).
+
+Parity contract: results agree with the NumPy oracle (``isocalc.centroids``)
+to ~3e-7 Da in m/z and ~1e-5 in normalized intensity (measured over 1,800
+real formula/adduct ions), NOT bit-exactly — device-mode caches therefore
+live under a separate parameter key.  Determinism: each segment's result
+depends only on its own (state-bucket) padded row, and buckets are chosen
+per SEGMENT, so the same ion produces the same bits regardless of which
+chunk or batch it rides in (the parallel==serial guarantee).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .isocalc import SEGMENT_GRID_CAP
+
+# per-segment state-count buckets: padding within a bucket costs masked
+# zeros, a new bucket costs one XLA compile.  Finer at the small end, where
+# the distribution mass lives (per-seg states p50=10, p90=28 on the full-DB
+# corpus): exp cost is linear in the bucket, so a 10-state segment in a
+# 16-bucket wastes 60% where a 12-bucket wastes 20%
+_STATE_BUCKETS = (4, 8, 12, 16, 24, 32, 48, 64, 128, 256, 512)
+# per-segment grid-length buckets: a typical isotope cluster needs ~1050
+# points (5-sigma support + a few-mDa span), so padding everything to the
+# 1536 cap wasted ~45% of the dense block
+_GRID_BUCKETS = (1152, SEGMENT_GRID_CAP)
+# dense-block budget: rows per batch = max(16, _BLOCK_ROWS // bucket), so
+# the (B, LC, S) f32 block stays ~50 MB
+_BLOCK_ROWS = 8192
+
+
+def _state_bucket(n: int) -> int:
+    for b in _STATE_BUCKETS:
+        if n <= b:
+            return b
+    return _STATE_BUCKETS[-1]
+
+
+def _grid_bucket(npts: int) -> int:
+    for b in _GRID_BUCKETS:
+        if npts <= b:
+            return b
+    return _GRID_BUCKETS[-1]
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel(lc: int, sc: int, b: int, k: int,
+            step: float, sigma: float, pad: float):
+    """Jitted per-segment blur->centroid for one (state bucket, rows) shape."""
+    import jax
+    import jax.numpy as jnp
+
+    inv2s2 = np.float32(-0.5 / (sigma * sigma))
+    win = np.float32(pad + step)
+    stepf = np.float32(step)
+
+    def run(m_rel, ab, seg_len):
+        # m_rel, ab: (B, Sc) f32 (padding: m_rel huge, ab 0)
+        # seg_len:   (B,) i32 grid length per segment
+        g = jnp.arange(lc, dtype=jnp.float32) * stepf          # (LC,)
+        # truncation mirrors the oracle's per-state windows (|x| > pad
+        # contributes zero there; the half-step slack admits at most one
+        # extra ~e^-12.5 tail point per edge)
+        if sc <= 64:
+            # UNROLLED accumulation: XLA fuses each state's x/exp/where
+            # chain into one pass over the (B, LC) accumulator — no
+            # (B, LC, Sc) intermediate ever materializes.  Measured 4.2x
+            # over the einsum form on XLA-CPU (9.4 vs 39.7 ms on the
+            # typical bucket; the einsum materialized x, x^2, exp, where
+            # blocks and went memory-bound at ~4.3 ns/element)
+            p = jnp.zeros((b, lc), jnp.float32)
+            for s in range(sc):
+                x = g[None, :] - m_rel[:, s: s + 1]
+                p = p + ab[:, s: s + 1] * jnp.where(
+                    jnp.abs(x) <= win, jnp.exp(inv2s2 * x * x), 0.0)
+        else:
+            # rare huge-cluster buckets: unrolling would bloat the program;
+            # the dense einsum is acceptable on the <1% of segments here
+            x = g[None, :, None] - m_rel[:, None, :]           # (B, LC, Sc)
+            w = jnp.where(jnp.abs(x) <= win, jnp.exp(inv2s2 * x * x), 0.0)
+            p = jnp.einsum("bls,bs->bl", w, ab)                # (B, LC)
+        # strict local maxima, excluding segment-boundary points (the
+        # oracle's `interior` mask) and the padded tail
+        larange = jnp.arange(lc, dtype=jnp.int32)
+        interior = ((larange[None, :] >= 1)
+                    & (larange[None, :] < seg_len[:, None] - 1))
+        mids = ((p[:, 1:-1] >= p[:, :-2]) & (p[:, 1:-1] > p[:, 2:])
+                & interior[:, 1:-1])
+        cand = jnp.where(mids, p[:, 1:-1], -1.0)
+        v, li = jax.lax.top_k(cand, k)                         # (B, k)
+        li = li + 1
+        rows = jnp.arange(b)[:, None]
+        y0, y1, y2 = p[rows, li - 1], p[rows, li], p[rows, li + 1]
+        # fallback support: the profile argmax (oracle: "no local max ->
+        # argmax"), with its parabola neighbors
+        gm = jnp.clip(jnp.argmax(p, axis=1), 1, lc - 2)
+        r = jnp.arange(b)
+        fb = jnp.stack([p[r, gm], p[r, gm - 1], p[r, gm + 1]], axis=1)
+        return v, li, y0, y1, y2, gm, fb
+
+    return jax.jit(run)
+
+
+def _parabola(y0, y1, y2, li):
+    """Vectorized sub-grid refinement — same arithmetic as the oracle.
+    Returns (height, grid_offset) f64 arrays."""
+    y0 = y0.astype(np.float64)
+    y1 = y1.astype(np.float64)
+    y2 = y2.astype(np.float64)
+    denom = y0 - 2.0 * y1 + y2
+    delta = np.where(np.abs(denom) > 0,
+                     0.5 * (y0 - y2) / np.where(denom == 0, 1.0, denom), 0.0)
+    delta = np.clip(delta, -0.5, 0.5)
+    height = y1 - 0.25 * (y0 - y2) * delta
+    return height, li.astype(np.float64) + delta
+
+
+class DeviceBlurCentroid:
+    """Packed-segment blur->centroid (see module doc).
+
+    One instance per isotope-generation parameter set; jitted executables
+    are cached per state bucket.  ``centroid_batch`` consumes the per-ion
+    segment lists produced by ``isocalc.fine_structure_segments`` and
+    returns oracle-compatible ``(mzs, ints)`` f64 pairs (m/z ascending,
+    intensities normalized to max=100).
+    """
+
+    def __init__(self, charge: int, isocalc_sigma: float,
+                 isocalc_pts_per_mz: int, n_peaks: int):
+        self.charge = charge
+        self.sigma = float(isocalc_sigma)
+        self.step = 1.0 / isocalc_pts_per_mz
+        self.pad = 5.0 * self.sigma
+        self.n_peaks = n_peaks
+        self.c_cap = n_peaks + 4
+        self.lc = SEGMENT_GRID_CAP
+
+    def centroid_batch(
+        self, seg_lists: list[list[tuple[float, np.ndarray, np.ndarray, int]]]
+    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Blur + centroid every ion; returns one (mzs, ints) per input."""
+        k = self.n_peaks
+        # flatten (ion, position) -> segment; group by per-SEGMENT bucket
+        seg_ion: list[int] = []
+        seg_pos: list[int] = []
+        seg_lo: list[float] = []
+        segs: list[tuple[np.ndarray, np.ndarray, int]] = []
+        for i, sl in enumerate(seg_lists):
+            for ci, (lo, m, a, npts) in enumerate(sl):
+                seg_ion.append(i)
+                seg_pos.append(ci)
+                seg_lo.append(lo)
+                segs.append((m, a, npts))
+        n_seg = len(segs)
+        v = np.empty((n_seg, k), np.float32)
+        li = np.empty((n_seg, k), np.int32)
+        y0 = np.empty((n_seg, k), np.float32)
+        y1 = np.empty((n_seg, k), np.float32)
+        y2 = np.empty((n_seg, k), np.float32)
+        gm = np.empty(n_seg, np.int32)
+        fb = np.empty((n_seg, 3), np.float32)
+
+        by_bucket: dict[tuple[int, int], list[int]] = {}
+        for si, (m, _a, npts) in enumerate(segs):
+            key = (_state_bucket(m.size), _grid_bucket(npts))
+            by_bucket.setdefault(key, []).append(si)
+        for (sc, lc), idxs in sorted(by_bucket.items()):
+            b = max(16, _BLOCK_ROWS // sc)
+            kern = _kernel(lc, sc, b, k, self.step, self.sigma, self.pad)
+            for s in range(0, len(idxs), b):
+                group = idxs[s: s + b]
+                m_rel = np.full((b, sc), 1e6, np.float32)
+                ab = np.zeros((b, sc), np.float32)
+                ln = np.zeros(b, np.int32)
+                for bi, si in enumerate(group):
+                    m, a, npts = segs[si]
+                    m_rel[bi, : m.size] = m
+                    ab[bi, : a.size] = a
+                    ln[bi] = npts
+                outs = kern(m_rel, ab, ln)
+                g = np.asarray(group)
+                for dst, src in zip((v, li, y0, y1, y2, gm, fb), outs):
+                    dst[g] = np.asarray(src)[: len(group)]
+        return self._assemble(seg_lists, np.asarray(seg_ion),
+                              np.asarray(seg_pos), np.asarray(seg_lo),
+                              v, li, y0, y1, y2, gm, fb)
+
+    def _assemble(self, seg_lists, seg_ion, seg_pos, seg_lo,
+                  v, li, y0, y1, y2, gm, fb):
+        """Vectorized host f64 finish: parabolic refinement, cross-segment
+        top-k by intensity, m/z-ascending order, max-100 normalization —
+        the exact oracle conventions, no per-ion Python loop."""
+        k = self.n_peaks
+        n_ions = len(seg_lists)
+        n_seg = seg_ion.size
+        h, off = _parabola(y0, y1, y2, li)                     # (Nseg, k)
+        mz = seg_lo[:, None] + self.step * off
+        valid = v > 0.0
+        # per-ion candidate matrices (n_ions, c_cap*k), -inf padded
+        cand_h = np.full((n_ions, self.c_cap * k), -np.inf)
+        cand_mz = np.zeros((n_ions, self.c_cap * k))
+        cols = (seg_pos[:, None] * k + np.arange(k)[None, :])  # (Nseg, k)
+        rows = np.broadcast_to(seg_ion[:, None], cols.shape)
+        cand_h[rows, cols] = np.where(valid, h, -np.inf)
+        cand_mz[rows, cols] = mz
+        # top n_peaks by height (descending), then m/z-ascending
+        order = np.argsort(-cand_h, axis=1, kind="stable")[:, :k]
+        rix = np.arange(n_ions)[:, None]
+        sel_h = cand_h[rix, order]
+        sel_mz = cand_mz[rix, order]
+        n_valid = (sel_h > -np.inf).sum(axis=1)
+        # fallback (oracle: "no local max -> argmax"): best segment by
+        # profile max, parabola at its argmax
+        none = n_valid == 0
+        if none.any():
+            seg_best = np.full(n_ions, -1, np.int64)
+            best_val = np.full(n_ions, -np.inf)
+            np.maximum.at(best_val, seg_ion, fb[:, 0].astype(np.float64))
+            match = fb[:, 0].astype(np.float64)[...] == best_val[seg_ion]
+            # last matching segment wins deterministically
+            seg_best[seg_ion[match]] = np.nonzero(match)[0]
+            for i in np.nonzero(none)[0]:
+                si = seg_best[i]
+                hh, oo = _parabola(fb[si, 1], fb[si, 0], fb[si, 2],
+                                   np.asarray(gm[si]))
+                sel_h[i, 0] = float(hh)
+                sel_mz[i, 0] = seg_lo[si] + self.step * float(oo)
+                n_valid[i] = 1
+        # m/z-ascending among the selected peaks (pad slots sort to the end)
+        sort_mz = np.where(sel_h > -np.inf, sel_mz, np.inf)
+        mz_order = np.argsort(sort_mz, axis=1, kind="stable")
+        sel_h = sel_h[rix, mz_order]
+        sel_mz = sel_mz[rix, mz_order]
+        out = []
+        for i in range(n_ions):
+            n = int(n_valid[i])
+            hi = sel_h[i, :n]
+            out.append((sel_mz[i, :n].copy(), 100.0 * hi / hi.max()))
+        return out

@@ -29,20 +29,29 @@ from ..utils.logger import logger
 _initialized = False
 
 
+def compile_cache_path(sm_config):
+    """The resolved persistent-cache directory (Path), or None when "off".
+    Shared by ``enable_compile_cache`` and the warmup-manifest trim
+    (models/msm_jax.py::JaxBackend.warmup)."""
+    d = sm_config.parallel.compile_cache_dir
+    if d == "off":
+        return None
+    from pathlib import Path
+
+    return Path(d) if d else Path(sm_config.work_dir) / "xla_cache"
+
+
 def enable_compile_cache(sm_config) -> None:
     """Point XLA's persistent compilation cache at a work-dir subdirectory
     so a dataset's second job (same shapes) skips the compile entirely —
     measured 15-20 s per dataset on a tunneled v5e, ~0.1 s warm.  ``"off"``
     disables; idempotent (jax.config.update is)."""
-    d = sm_config.parallel.compile_cache_dir
-    if d == "off":
+    path = compile_cache_path(sm_config)
+    if path is None:
         return
-    from pathlib import Path
-
     import jax
 
-    path = d or str(Path(sm_config.work_dir) / "xla_cache")
-    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_compilation_cache_dir", str(path))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 

@@ -210,6 +210,33 @@ class Histogram(_Metric):
         return lines
 
 
+def rate_collector(registry: "MetricsRegistry", name: str, help: str,
+                   count_fn) -> None:
+    """Register a scrape-time collector that derives a per-second rate gauge
+    from a monotone count supplier ``count_fn()``.
+
+    Prometheus clients usually rate() counters server-side, but the engine's
+    in-process consumers (admin API, chaos drivers, the isocalc progress
+    line) want a ready-made gauge: the value is the count delta since the
+    previous scrape divided by the elapsed wall time (0 on the first scrape
+    or when time stands still)."""
+    import time
+
+    state = {"count": None, "t": None}
+
+    def collect(reg: "MetricsRegistry") -> None:
+        now = time.monotonic()
+        count = float(count_fn())
+        prev_c, prev_t = state["count"], state["t"]
+        rate = 0.0
+        if prev_c is not None and now > prev_t:
+            rate = max(0.0, count - prev_c) / (now - prev_t)
+        state["count"], state["t"] = count, now
+        reg.gauge(name, help).set(rate)
+
+    registry.add_collector(collect)
+
+
 class MetricsRegistry:
     """Registry: owns metric families + scrape-time collect callbacks."""
 

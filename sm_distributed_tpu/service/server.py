@@ -52,6 +52,16 @@ class AnnotationService:
         # chaos observability: sm_failpoints_injected_total{name=} and
         # sm_recovery_events_total{event=} surface on /metrics
         attach_failpoint_metrics(self.metrics)
+        # isocalc cold-path observability (ISSUE 3): pattern counter +
+        # per-generation worker/rate gauges, plus a scrape-window rate
+        from ..ops import isocalc as isocalc_mod
+        from .metrics import rate_collector
+
+        isocalc_mod.attach_metrics(self.metrics)
+        rate_collector(self.metrics, "sm_isocalc_patterns_scrape_rate_per_s",
+                       "Isotope patterns computed per second, over the "
+                       "window since the previous scrape",
+                       isocalc_mod.patterns_total)
         if residency is not None:
             self.metrics.add_collector(self._collect_residency)
         self.api = AdminAPI(self, host=cfg.http_host,

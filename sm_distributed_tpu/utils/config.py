@@ -151,6 +151,23 @@ class ParallelConfig:
     # datasets with the same shapes skip the ~15-20s TPU compile entirely),
     # "off" = disabled, anything else = explicit directory
     compile_cache_dir: str = ""
+    # --- isotope-pattern cold path (ops/isocalc.py, docs/ISOCALC.md) ---
+    # process-pool size for cold pattern generation: 0 = all cores
+    # (env SM_ISOCALC_PROCS overrides a 0 here)
+    isocalc_workers: int = 0
+    # (formula, adduct) pairs per generation chunk == per incremental cache
+    # shard: 0 = default (2048; env SM_ISOCALC_CHUNK overrides a 0 here)
+    isocalc_chunk: int = 0
+    # batched device (XLA) blur->centroid stage: "on" routes the
+    # post-convolution math through ops/isocalc_jax.py.  Results match the
+    # NumPy oracle to ~1e-5 (NOT bit-exact; separate cache namespace), so
+    # the default stays "off" — the pinned golden report is oracle bits.
+    isocalc_device: str = "off"
+    # overlap isotope generation with the rest of the job: SearchJob stages/
+    # parses concurrently with isocalc, and (numpy_ref backend) scoring
+    # starts on the leading checkpoint groups while later patterns are
+    # still computing.  "off" restores strictly serial phases.
+    overlap_isocalc: str = "auto"
     # daemon service mode: how many datasets' parsed layouts + compiled
     # backends stay resident across queue messages (LRU; 0 disables) —
     # engine/residency.py
@@ -216,7 +233,9 @@ class SMConfig:
             raise ValueError(f"backend must be one of {VALID_BACKENDS}, got {self.backend!r}")
         for knob, valid in (("order_ions", ("auto", "mz", "table")),
                             ("band_slice", ("auto", "on", "off")),
-                            ("peak_compaction", ("auto", "on", "off"))):
+                            ("peak_compaction", ("auto", "on", "off")),
+                            ("isocalc_device", ("on", "off")),
+                            ("overlap_isocalc", ("auto", "on", "off"))):
             v = getattr(self.parallel, knob)
             if v not in valid:
                 raise ValueError(

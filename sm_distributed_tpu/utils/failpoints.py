@@ -95,6 +95,7 @@ _metrics = None                         # attached MetricsRegistry (optional)
 
 # None = disabled; the failpoint() fast path is one read + None test
 _active: "dict[str, _Spec] | None" = None
+_active_spec: str | None = None            # the spec text behind _active
 
 
 def register_failpoint(name: str, description: str = "") -> str:
@@ -185,19 +186,31 @@ def parse_failpoints(text: str) -> dict[str, _Spec]:
 def configure(spec: str | None) -> None:
     """Activate a spec string (env-var grammar); ``None``/empty disables.
     Replaces any previous activation and resets hit counters."""
-    global _active
+    global _active, _active_spec
     with _lock:
+        _active_spec = spec or None
         if not spec:
             _active = None
             return
         _active = parse_failpoints(spec)
 
 
+def active_spec() -> str | None:
+    """The currently-armed spec string (or None).  Process pools spawned by
+    the engine pass this to their worker initializers so a programmatic
+    ``configure()`` in the parent reaches spawned children the same way the
+    ``SM_FAILPOINTS`` env var does (children re-read the env at import, but
+    never see the parent's in-process configuration)."""
+    with _lock:
+        return _active_spec
+
+
 def reset() -> None:
     """Disable injection and clear the injected/recovery counters (tests)."""
-    global _active
+    global _active, _active_spec
     with _lock:
         _active = None
+        _active_spec = None
         _injected.clear()
         _recovered.clear()
 

@@ -28,8 +28,10 @@ def test_report_schema_and_values():
         "numpy_floor_spread", "numpy_floor_spread_mid5",
         "numpy_floor_n_ions", "floor_procs",
         "numpy_floor_multiproc_ions_per_s", "vs_baseline_multiproc",
-        "compile_s", "warmup_retried", "xla_cache_entries_before",
+        "compile_s", "warmup_retried", "warmup_skipped",
+        "xla_cache_entries_before",
         "n_ions", "n_pixels", "pixels_per_s", "isocalc_s",
+        "isocalc_cold_s", "isocalc_workers", "patterns_per_s",
     }
     assert out["value"] == 5000.0
     assert out["vs_baseline"] == 100.0
@@ -37,6 +39,7 @@ def test_report_schema_and_values():
     assert out["compile_s"] == 12.0
     # warmup_retried defaults False when absent and passes through when set
     assert out["warmup_retried"] is False
+    assert out["warmup_skipped"] is False
     assert out["xla_cache_entries_before"] == 7
     assert out["numpy_floor_ions_per_s"] == 50.0
     assert out["numpy_floor_spread_mid5"] == 0.05
@@ -45,12 +48,29 @@ def test_report_schema_and_values():
     assert out["n_ions"] == 100 and out["n_pixels"] == 4096
     assert out["pixels_per_s"] == 5000.0 * 4096
     assert out["isocalc_s"] == 0.5
+    # cold-path fields are None on cases that skip the regeneration
+    assert out["isocalc_cold_s"] is None
+    assert out["isocalc_workers"] is None
+    assert out["patterns_per_s"] is None
 
 
 def test_report_flags_retried_warmup():
     prep, floor, jaxr = _fake_inputs()
     jaxr["warmup_retried"] = True
-    assert report(prep, floor, jaxr)["warmup_retried"] is True
+    jaxr["warmup_skipped"] = True
+    out = report(prep, floor, jaxr)
+    assert out["warmup_retried"] is True
+    assert out["warmup_skipped"] is True
+
+
+def test_report_isocalc_cold_fields():
+    prep, floor, jaxr = _fake_inputs()
+    iso = dict(isocalc_cold_s=12.345, isocalc_workers=4,
+               patterns_per_s=812.5)
+    out = report(prep, floor, jaxr, iso)
+    assert out["isocalc_cold_s"] == 12.35
+    assert out["isocalc_workers"] == 4
+    assert out["patterns_per_s"] == 812.5
 
 
 def test_transient_warmup_error_matcher():
