@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 from pathlib import Path
@@ -269,13 +270,14 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
     # compiles).  All cases share the one cache dir, so 0 means certainly
     # cold; nonzero means at least partially warm (earlier cases' entries
     # count too — per-case key attribution isn't available from here).
-    # Lock/tmp/hidden files the cache layer writes are excluded so the
-    # count reflects actual cached executables (ADVICE r5; still
-    # approximate in that keys aren't attributed per case)
+    # Count ONLY real executable entries — `jit_<name>-<hex digest>` files,
+    # excluding the `-atime` access-time sidecars and any lock/tmp/hidden
+    # files the cache layer writes — so nonzero STRICTLY implies warm
+    # executables (ADVICE r5).
+    _entry_re = re.compile(r"^jit_.+-[0-9a-f]{32,}(-cache)?$")
     cache_entries = sum(
-        1 for p in (cache_dir / "xla_cache").glob("*")
-        if p.is_file() and not p.name.startswith(".")
-        and p.suffix not in (".lock", ".tmp")
+        1 for p in (cache_dir / "xla_cache").glob("jit_*")
+        if p.is_file() and _entry_re.match(p.name)
     ) if (cache_dir / "xla_cache").exists() else 0
     backend = make_backend("jax_tpu", prep["ds"], prep["ds_config"],
                            sm_config, table=prep["table"])

@@ -101,6 +101,10 @@ class Scenario:
     # 72-pair fixture generates in several chunks and mid-generation crashes
     # leave a real shard prefix to resume from
     env: dict = field(default_factory=dict)
+    # per-scenario SMConfig overrides, deep-merged over SM_TEMPLATE: e.g. a
+    # 1s job_timeout_s so the cancel-delivery seam actually executes, or
+    # backend=jax_tpu + breaker_threshold=1 for the breaker-open scenario
+    sm: dict = field(default_factory=dict)
 
 
 # Every registered failpoint has exactly one scenario (enforced by
@@ -152,6 +156,19 @@ SCENARIOS: list[Scenario] = [
              "isocalc.worker=crash@2;isocalc.shard_load=raise:OSError@1",
              "cache shard read error degrades to recompute, not a crash",
              spec_runs=2, env={"SM_ISOCALC_CHUNK": "32"}),
+    # --- overload/cancellation seams (ISSUE 4) -------------------------
+    Scenario("sched.cancel_deliver", "consume",
+             "sched.cancel_deliver=crash@1;device.score_batch=sleep:5",
+             "crash mid-cancellation (attempt timed out, cancel not yet "
+             "delivered); restart requeues the claim and reruns cleanly",
+             sm={"service": {"job_timeout_s": 1.0, "cancel_grace_s": 2.0}}),
+    Scenario("backend.device_error", "consume",
+             "backend.device_error=raise:RuntimeError@1",
+             "device error opens the breaker mid-job; scoring degrades to "
+             "the numpy oracle in place and still matches golden",
+             sm={"backend": "jax_tpu",
+                 "service": {"breaker_threshold": 1,
+                             "breaker_cooldown_s": 0.05}}),
 ]
 
 SMOKE = ("ckpt.shard_write", "spool.complete", "storage.results_rename")
@@ -198,12 +215,23 @@ def _run_sub(args: list[str], spec: str | None,
     return proc.returncode, proc.stdout + proc.stderr
 
 
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 @dataclass
 class Context:
     """Per-scenario sandbox: its own spool, results, and work dirs."""
 
     base: Path
     msg: dict
+    sm_overrides: dict = field(default_factory=dict)
     sm_conf: Path = field(init=False)
     queue_dir: Path = field(init=False)
     root: Path = field(init=False)
@@ -216,7 +244,8 @@ class Context:
         self.results = self.base / "results"
         self.work = self.base / "work"
         self.base.mkdir(parents=True, exist_ok=True)
-        sm = json.loads(json.dumps(SM_TEMPLATE))
+        sm = _deep_merge(json.loads(json.dumps(SM_TEMPLATE)),
+                         self.sm_overrides)
         sm["work_dir"] = str(self.work)
         sm["storage"]["results_dir"] = str(self.results)
         self.sm_conf = self.base / "sm.json"
@@ -310,7 +339,7 @@ def check_invariants(ctx: Context, golden) -> list[str]:
 
 def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
                  verbose: bool = False) -> dict:
-    ctx = Context(base / sc.primary.replace(".", "_"), msg)
+    ctx = Context(base / sc.primary.replace(".", "_"), msg, sc.sm)
     outputs: list[str] = []
     result = {"scenario": sc.primary, "spec": sc.spec, "runs": 0, "ok": False}
 

@@ -3,10 +3,13 @@
 # command and fails if DOTS_PASSED drops below the seed baseline, so test
 # regressions are caught mechanically instead of by eyeballing pytest output.
 #
-# Usage: scripts/check_tier1.sh [BASELINE] [--chaos]   (default baseline: 137)
+# Usage: scripts/check_tier1.sh [BASELINE] [--chaos] [--load]  (default baseline: 137)
 #
 #   --chaos   also run the fast chaos smoke stage (3-failpoint subset of
 #             scripts/chaos_sweep.py) after the test gate (ISSUE 2 satellite)
+#             AND the load-sweep smoke gate (small burst + one poison job +
+#             one deadline job through the real service; ISSUE 4 satellite)
+#   --load    run only the load-sweep smoke gate after the test gate
 #
 # Always runs the failpoint registry gate first: registered names must be
 # unique (duplicate registration raises at import), documented in
@@ -21,9 +24,11 @@ set -u -o pipefail
 
 BASELINE="137"
 RUN_CHAOS=0
+RUN_LOAD=0
 for arg in "$@"; do
     case "$arg" in
-        --chaos) RUN_CHAOS=1 ;;
+        --chaos) RUN_CHAOS=1; RUN_LOAD=1 ;;
+        --load) RUN_LOAD=1 ;;
         *) BASELINE="$arg" ;;
     esac
 done
@@ -74,4 +79,13 @@ if [ "$RUN_CHAOS" -eq 1 ]; then
         exit 1
     fi
     echo "check_tier1: chaos smoke OK"
+fi
+
+if [ "$RUN_LOAD" -eq 1 ]; then
+    echo "check_tier1: running load-sweep smoke stage"
+    if ! env JAX_PLATFORMS=cpu python scripts/load_sweep.py --smoke; then
+        echo "check_tier1: FAIL — load-sweep smoke stage failed" >&2
+        exit 1
+    fi
+    echo "check_tier1: load-sweep smoke OK"
 fi

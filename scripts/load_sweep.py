@@ -1,0 +1,494 @@
+#!/usr/bin/env python
+"""Overload-protection load sweep (ISSUE 4 proof).
+
+Drives a REAL in-process ``AnnotationService`` — spool, scheduler, admin
+API, real ``SearchJob``s on synthetic fixtures — with the traffic mixes the
+admission/cancellation/degradation layer exists for, and asserts the
+serving invariants after each mix:
+
+- **burst**: 4x-capacity submit burst → queue depth stays below the
+  configured bound, every shed submit gets a structured 429/503 with a
+  ``Retry-After`` header and a JSON ``reason``, every accepted job reaches
+  a terminal state;
+- **sustained**: paced tenant-rotating traffic → bounded depth, everything
+  terminal;
+- **deadline**: an expired-in-queue job and a trips-mid-run job → both
+  terminal with a deadline error, no partial results, no debris;
+- **cancel**: ``DELETE /jobs/<id>`` on a running job → terminal
+  ``cancelled``, the attempt thread unwinds (zero live ``attempt-*``
+  threads), the device token is released;
+- **poison**: a job that fails every attempt dead-letters with its
+  traceback; a message whose persisted ``service.claims`` says it
+  crash-looped its claims moves to ``quarantine/`` (the real process-crash
+  loop is proven by ``scripts/chaos_sweep.py`` — here the claim counter is
+  pre-stamped so the sweep stays in-process);
+- **breaker** (full matrix only): with ``backend=jax_tpu`` and injected
+  device errors (``backend.device_error`` failpoint), the circuit breaker
+  demonstrably opens, jobs degrade to numpy scoring, and after the faults
+  are healed a half-open probe closes it again.
+
+Usage::
+
+    python scripts/load_sweep.py              # full matrix
+    python scripts/load_sweep.py --smoke      # burst + poison + deadline (CI)
+    python scripts/load_sweep.py --keep --work DIR
+
+``SM_FAILPOINTS`` may be exported to combine any mix with fault injection
+(raise/sleep/torn actions only — a ``crash`` action would kill the driver
+itself; use the chaos sweep for process-death faults).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from scripts.chaos_sweep import _debris  # noqa: E402 — shared invariant
+from sm_distributed_tpu.engine.daemon import annotate_callback  # noqa: E402
+from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset  # noqa: E402
+from sm_distributed_tpu.models import breaker as breaker_mod  # noqa: E402
+from sm_distributed_tpu.service import AnnotationService  # noqa: E402
+from sm_distributed_tpu.utils import failpoints  # noqa: E402
+from sm_distributed_tpu.utils.config import SMConfig  # noqa: E402
+
+TERMINAL = ("done", "failed", "cancelled", "quarantined")
+
+
+class SweepError(AssertionError):
+    pass
+
+
+def _check(cond, msg: str) -> None:
+    if not cond:
+        raise SweepError(msg)
+
+
+# ---------------------------------------------------------------- HTTP glue
+def _http(base: str, method: str, path: str, body: dict | None = None):
+    """(status, headers, parsed-json) — 4xx/5xx returned, not raised."""
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(base + path, method=method, data=data,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30.0) as r:
+            return r.status, dict(r.headers), json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        try:
+            parsed = json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            parsed = {"raw": raw.decode(errors="replace")}
+        return e.code, dict(e.headers), parsed
+
+
+# ------------------------------------------------------------------ harness
+class Harness:
+    """One service instance + the assertion helpers every mix shares."""
+
+    def __init__(self, base: Path, name: str, sm_overrides: dict | None = None):
+        self.dir = base / name
+        self.queue_dir = self.dir / "queue"
+        self.root = self.queue_dir / "sm_annotate"
+        sm = {
+            "backend": "numpy_ref",
+            "fdr": {"decoy_sample_size": 2, "seed": 1},
+            "parallel": {"formula_batch": 8, "checkpoint_every": 1,
+                         "resident_datasets": 2, "order_ions": "table"},
+            "storage": {"results_dir": str(self.dir / "results"),
+                        "store_images": False},
+            "work_dir": str(self.dir / "work"),
+            "service": {
+                "workers": 2, "poll_interval_s": 0.02, "job_timeout_s": 30.0,
+                "max_attempts": 2, "backoff_base_s": 0.05,
+                "backoff_max_s": 0.2, "backoff_jitter": 0.0,
+                "heartbeat_interval_s": 0.1, "stale_after_s": 2.0,
+                "drain_timeout_s": 20.0, "cancel_grace_s": 10.0,
+                "quarantine_after": 3, "http_port": 0,
+                "admission": {"max_queue_depth": 6, "max_tenant_inflight": 4,
+                              "retry_after_s": 1.0},
+            },
+        }
+        if sm_overrides:
+            sm = _merge(sm, sm_overrides)
+        self.sm_config = SMConfig.from_dict(sm)
+        self.service = AnnotationService(
+            self.queue_dir, annotate_callback(self.sm_config),
+            sm_config=self.sm_config)
+        self.service.start()
+        host, port = self.service.api.address
+        self.base = f"http://{host}:{port}"
+
+    # ------------------------------------------------------------- actions
+    def submit(self, msg: dict):
+        return _http(self.base, "POST", "/submit", msg)
+
+    def delete(self, msg_id: str):
+        return _http(self.base, "DELETE", f"/jobs/{msg_id}")
+
+    def jobs(self) -> dict:
+        _s, _h, rows = _http(self.base, "GET", "/jobs")
+        return {r["msg_id"]: r for r in rows}
+
+    def metrics_text(self) -> str:
+        req = urllib.request.Request(self.base + "/metrics")
+        with urllib.request.urlopen(req, timeout=30.0) as r:
+            return r.read().decode()
+
+    def wait_terminal(self, msg_ids, timeout_s: float = 120.0) -> dict:
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            rows = self.jobs()
+            if all(m in rows and rows[m]["state"] in TERMINAL
+                   for m in msg_ids):
+                return rows
+            time.sleep(0.05)
+        rows = self.jobs()
+        missing = {m: rows.get(m, {}).get("state", "absent") for m in msg_ids
+                   if rows.get(m, {}).get("state") not in TERMINAL}
+        raise SweepError(f"jobs never reached a terminal state: {missing}")
+
+    # ---------------------------------------------------------- invariants
+    def sample_depth(self) -> int:
+        """Admitted-but-not-terminal occupancy as seen on disk."""
+        return (len(list(self.root.glob("pending/*.json")))
+                + len(list(self.root.glob("running/*.json"))))
+
+    def assert_clean(self, label: str) -> None:
+        zombies = [t.name for t in threading.enumerate()
+                   if t.name.startswith("attempt-") and t.is_alive()]
+        _check(not zombies, f"{label}: live attempt threads leaked: {zombies}")
+        token = self.service.scheduler.device_token
+        got = token.acquire(timeout=1.0)
+        _check(got, f"{label}: device token still held")
+        if got:
+            token.release()
+        leftovers = _debris([self.root, self.dir / "results",
+                             self.dir / "work"])
+        # checkpoint shards under work/ are legitimate mid-crash resume
+        # state for FAILED jobs; everything else must be gone
+        leftovers = [p for p in leftovers if ".ckpt." not in p]
+        _check(not leftovers, f"{label}: tmp/heartbeat debris: {leftovers}")
+        _check(not list(self.root.glob("running/*")),
+               f"{label}: running/ not empty after drain")
+
+    def shutdown(self):
+        self.service.shutdown()
+
+
+def _merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+# ----------------------------------------------------------------- fixtures
+def build_fixtures(base: Path) -> dict:
+    """One tiny dataset every job shares (the isocalc cache + resident
+    backend warm after job 1, so burst jobs are fast).  Mixes that need a
+    deterministically LONG job arm a ``device.score_batch=sleep:...``
+    failpoint instead of guessing at a bigger fixture's duration."""
+    fast_path, fast_truth = generate_synthetic_dataset(
+        base / "fx_fast", nrows=8, ncols=8, formulas=None,
+        present_fraction=0.5, noise_peaks=30, seed=11)
+    return {
+        "fast": {"input_path": str(fast_path),
+                 "formulas": fast_truth.formulas[:3],
+                 "ds_config": {"isotope_generation": {"adducts": ["+H"]}}},
+    }
+
+
+def _msg(fx: dict, kind: str, ds_id: str, **extra) -> dict:
+    m = {"ds_id": ds_id, "msg_id": ds_id, **fx[kind], **extra}
+    return m
+
+
+# -------------------------------------------------------------------- mixes
+def mix_burst(h: Harness, fx: dict, n_submit: int) -> None:
+    """4x-capacity burst: bounded depth, structured sheds, all accepted
+    jobs terminal."""
+    cap = h.sm_config.service.admission.max_queue_depth
+    accepted, shed = [], []
+    max_depth = 0
+    for i in range(n_submit):
+        status, headers, body = h.submit(
+            _msg(fx, "fast", f"burst{i}", tenant=f"t{i % 3}"))
+        if status == 202:
+            accepted.append(body["msg_id"])
+        else:
+            shed.append((status, headers, body))
+        max_depth = max(max_depth, h.sample_depth())
+    _check(accepted, "burst: nothing was accepted")
+    _check(shed, f"burst: {n_submit} submits at capacity {cap} shed nothing")
+    for status, headers, body in shed:
+        _check(status in (429, 503), f"burst: shed status {status}")
+        _check("Retry-After" in headers,
+               f"burst: shed response missing Retry-After: {headers}")
+        _check(body.get("reason") in ("queue_full", "tenant_quota",
+                                      "latency_overload"),
+               f"burst: unstructured shed body {body}")
+        _check("retry_after_s" in body and "error" in body,
+               f"burst: shed body missing fields {body}")
+    rows = h.wait_terminal(accepted)
+    bad = [m for m in accepted if rows[m]["state"] != "done"]
+    _check(not bad, f"burst: accepted jobs not done: "
+                    f"{[(m, rows[m]['state'], rows[m]['error']) for m in bad]}")
+    # the depth bound: pending+running on disk never exceeded the admission
+    # cap (direct spool publishes would bypass it; everything here is HTTP)
+    _check(max_depth <= cap,
+           f"burst: observed depth {max_depth} > configured bound {cap}")
+    while h.sample_depth():
+        time.sleep(0.05)
+    h.assert_clean("burst")
+    print(f"  burst: {len(accepted)} accepted, {len(shed)} shed "
+          f"(max depth {max_depth}/{cap})")
+
+
+def mix_sustained(h: Harness, fx: dict, n_submit: int, gap_s: float) -> None:
+    cap = h.sm_config.service.admission.max_queue_depth
+    accepted, shed = [], []
+    max_depth = 0
+    for i in range(n_submit):
+        status, _hd, body = h.submit(
+            _msg(fx, "fast", f"sus{i}", tenant=f"t{i % 4}"))
+        (accepted if status == 202 else shed).append(
+            body.get("msg_id", f"sus{i}"))
+        max_depth = max(max_depth, h.sample_depth())
+        time.sleep(gap_s)
+    rows = h.wait_terminal(accepted)
+    bad = [m for m in accepted if rows[m]["state"] != "done"]
+    _check(not bad, f"sustained: not done: {bad}")
+    _check(max_depth <= cap, f"sustained: depth {max_depth} > {cap}")
+    text = h.metrics_text()
+    _check("sm_admission_latency_ewma_s" in text,
+           "sustained: EWMA gauge missing from /metrics")
+    h.assert_clean("sustained")
+    print(f"  sustained: {len(accepted)} accepted, {len(shed)} shed "
+          f"(max depth {max_depth}/{cap})")
+
+
+def mix_deadline(h: Harness, fx: dict) -> None:
+    prev = failpoints.active_spec()
+    # every checkpoint group sleeps: jobs become deterministically long, so
+    # the mid-run job's deadline reliably trips BETWEEN group boundaries
+    failpoints.configure("device.score_batch=sleep:0.35")
+    try:
+        # starts immediately on an idle worker; ~1s of scoring against a
+        # 0.6s deadline → the cancel lands mid-attempt
+        status, _hd, body = h.submit(
+            _msg(fx, "fast", "dl_midrun", deadline_s=0.6))
+        _check(status == 202, f"deadline: submit failed ({status})")
+        midrun_id = body["msg_id"]
+        # occupy the remaining workers so the tight-deadline job below
+        # expires while still QUEUED
+        occupiers = []
+        for i in range(2):
+            status, _hd, body = h.submit(_msg(fx, "fast", f"occupy{i}"))
+            _check(status == 202, f"deadline: occupier shed ({status})")
+            occupiers.append(body["msg_id"])
+        status, _hd, body = h.submit(
+            _msg(fx, "fast", "dl_queued", deadline_s=0.05))
+        _check(status == 202, f"deadline: submit failed ({status})")
+        queued_id = body["msg_id"]
+        rows = h.wait_terminal(occupiers + [queued_id, midrun_id])
+    finally:
+        failpoints.configure(prev)
+    for mid, marker in ((queued_id, "before start"),
+                        (midrun_id, "deadline")):
+        _check(rows[mid]["state"] == "failed",
+               f"deadline: {mid} state {rows[mid]['state']} "
+               f"({rows[mid]['error']!r})")
+        _check("deadline" in rows[mid]["error"] and marker in rows[mid]["error"],
+               f"deadline: {mid} error {rows[mid]['error']!r}")
+        _check(rows[mid]["attempts"] <= 1,
+               f"deadline: {mid} was retried ({rows[mid]['attempts']} attempts)")
+        dl = json.loads((h.root / "failed" / f"{mid}.json").read_text())
+        _check("deadline" in dl["error"], f"deadline: spool file {dl}")
+    # no partial results for the mid-run expiry
+    _check(not (h.dir / "results" / "dl_midrun" / "annotations.parquet").exists(),
+           "deadline: cancelled job stored partial results")
+    h.assert_clean("deadline")
+    print(f"  deadline: queued-expiry + mid-run expiry both terminal, "
+          f"occupiers {[rows[m]['state'] for m in occupiers]}")
+
+
+def mix_cancel(h: Harness, fx: dict) -> None:
+    prev = failpoints.active_spec()
+    failpoints.configure("device.score_batch=sleep:0.35")
+    try:
+        status, _hd, body = h.submit(_msg(fx, "fast", "cancel_me"))
+        _check(status == 202, f"cancel: submit failed ({status})")
+        mid = body["msg_id"]
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            rows = h.jobs()
+            if rows.get(mid, {}).get("state") == "running":
+                break
+            time.sleep(0.02)
+        else:
+            raise SweepError("cancel: job never started running")
+        status, _hd, body = h.delete(mid)
+    finally:
+        failpoints.configure(prev)
+    _check(status in (200, 202), f"cancel: DELETE status {status} {body}")
+    rows = h.wait_terminal([mid])
+    _check(rows[mid]["state"] == "cancelled",
+           f"cancel: state {rows[mid]['state']} ({rows[mid]['error']!r})")
+    dl = json.loads((h.root / "failed" / f"{mid}.json").read_text())
+    _check(dl.get("cancelled") is True, f"cancel: spool file {dl}")
+    _check(not (h.dir / "results" / "cancel_me" / "annotations.parquet").exists(),
+           "cancel: cancelled job stored results")
+    # second DELETE reports terminal, unknown id is a structured 404
+    status, _hd, _b = h.delete(mid)
+    _check(status == 409, f"cancel: re-DELETE status {status}")
+    status, _hd, _b = h.delete("no_such_job")
+    _check(status == 404, f"cancel: unknown-id status {status}")
+    h.assert_clean("cancel")
+    print("  cancel: running job cancelled cleanly, token released")
+
+
+def mix_poison(h: Harness, fx: dict) -> None:
+    # (a) fails every attempt → dead-letter with the traceback
+    status, _hd, body = h.submit(
+        {"ds_id": "poison_dl", "msg_id": "poison_dl",
+         "input_path": str(h.dir / "nope.imzML")})
+    _check(status == 202, f"poison: submit failed ({status})")
+    dl_id = body["msg_id"]
+    # (b) a crash-looper: its persisted claim counter says it has been
+    # claimed quarantine_after times without a terminal outcome (the chaos
+    # sweep proves the counter moves under real process crashes)
+    q_after = h.sm_config.service.quarantine_after
+    status, _hd, body = h.submit(
+        _msg(fx, "fast", "poison_q",
+             service={"claims": q_after, "last_error": "simulated crash loop"}))
+    _check(status == 202, f"poison: submit failed ({status})")
+    q_id = body["msg_id"]
+    rows = h.wait_terminal([dl_id, q_id])
+    _check(rows[dl_id]["state"] == "failed",
+           f"poison: dead-letter state {rows[dl_id]['state']}")
+    dl = json.loads((h.root / "failed" / f"{dl_id}.json").read_text())
+    _check(dl["attempts"] == h.sm_config.service.max_attempts
+           and "traceback" in dl, f"poison: dead-letter evidence {list(dl)}")
+    _check(rows[q_id]["state"] == "quarantined",
+           f"poison: quarantine state {rows[q_id]['state']}")
+    qf = json.loads((h.root / "quarantine" / f"{q_id}.json").read_text())
+    _check("quarantine_reason" in qf
+           and qf["service"]["claims"] == q_after + 1,
+           f"poison: quarantine evidence {qf}")
+    _check("sm_jobs_quarantined_total 1" in h.metrics_text(),
+           "poison: quarantine counter missing from /metrics")
+    h.assert_clean("poison")
+    print("  poison: dead-letter w/ traceback + quarantine/ both reached")
+
+
+def mix_breaker(base: Path, fx: dict) -> None:
+    """Device errors open the breaker; jobs degrade to numpy; healing +
+    cooldown recovers through a half-open probe (backend=jax_tpu on
+    whatever platform jax has — CPU in CI)."""
+    breaker_mod.reset_device_breaker()
+    h = Harness(base, "breaker", sm_overrides={
+        "backend": "jax_tpu",
+        "service": {"max_attempts": 3, "breaker_threshold": 2,
+                    "breaker_cooldown_s": 0.5},
+    })
+    try:
+        failpoints.configure("backend.device_error=raise:RuntimeError?1")
+        ids = []
+        for name in ("brk1", "brk2"):
+            status, _hd, body = h.submit(_msg(fx, "fast", name))
+            _check(status == 202, f"breaker: submit failed ({status})")
+            ids.append(body["msg_id"])
+            h.wait_terminal([body["msg_id"]])
+        rows = h.jobs()
+        _check(all(rows[m]["state"] == "done" for m in ids),
+               f"breaker: jobs under device faults not done: "
+               f"{[(m, rows[m]['state']) for m in ids]}")
+        brk = breaker_mod.get_device_breaker()
+        _check(brk.state == "open",
+               f"breaker: expected open after injected faults, got {brk.state}")
+        # heal the device, wait out the cooldown, probe
+        failpoints.configure(None)
+        time.sleep(h.sm_config.service.breaker_cooldown_s + 0.1)
+        status, _hd, body = h.submit(_msg(fx, "fast", "brk_probe"))
+        _check(status == 202, f"breaker: probe submit failed ({status})")
+        h.wait_terminal([body["msg_id"]])
+        rows = h.jobs()
+        _check(rows[body["msg_id"]]["state"] == "done",
+               f"breaker: probe job {rows[body['msg_id']]['state']}")
+        _check(brk.state == "closed",
+               f"breaker: expected closed after probe, got {brk.state}")
+        hops = [(f, t) for _ts, f, t in brk.transitions]
+        for hop in (("closed", "open"), ("open", "half_open"),
+                    ("half_open", "closed")):
+            _check(hop in hops, f"breaker: transition {hop} missing: {hops}")
+        text = h.metrics_text()
+        _check("sm_breaker_degraded_total" in text
+               and 'sm_breaker_transitions_total{to="open"}' in text,
+               "breaker: /metrics missing breaker families")
+        h.assert_clean("breaker")
+        print(f"  breaker: opened, degraded to numpy, recovered "
+              f"(transitions {hops})")
+    finally:
+        failpoints.configure(None)
+        h.shutdown()
+        breaker_mod.reset_device_breaker()
+
+
+# ------------------------------------------------------------------- driver
+def run_sweep(work: Path, smoke: bool = False) -> int:
+    work.mkdir(parents=True, exist_ok=True)
+    fx = build_fixtures(work)
+    t0 = time.time()
+    h = Harness(work, "main")
+    try:
+        print(f"load sweep ({'smoke' if smoke else 'full'}) at {h.base}")
+        mix_burst(h, fx, n_submit=(12 if smoke else 24))
+        if not smoke:
+            mix_sustained(h, fx, n_submit=10, gap_s=0.1)
+            mix_cancel(h, fx)
+        mix_deadline(h, fx)
+        mix_poison(h, fx)
+    finally:
+        h.shutdown()
+    if not smoke:
+        mix_breaker(work, fx)
+    print(f"load sweep OK ({time.time() - t0:.1f}s)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI subset: burst + deadline + poison")
+    ap.add_argument("--work", default=None)
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args(argv)
+    import shutil
+    import tempfile
+
+    work = Path(args.work) if args.work else Path(
+        tempfile.mkdtemp(prefix="sm_load_"))
+    try:
+        return run_sweep(work, smoke=args.smoke)
+    except SweepError as exc:
+        print(f"load sweep FAILED: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        if not args.keep and args.work is None:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,10 @@ from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
 
 QUEUE_ANNOTATE = "sm_annotate"
-_STATES = ("pending", "running", "done", "failed")
+# quarantine/ holds messages the service scheduler parked after they crash-
+# looped their claims (service/scheduler.py::_quarantine); the blocking
+# consumer never writes it but creates it so both drain one spool layout
+_STATES = ("pending", "running", "done", "failed", "quarantine")
 
 FP_PUBLISH_RENAME = register_failpoint(
     "spool.publish_rename",
@@ -282,6 +285,9 @@ def annotate_callback(sm_config: SMConfig, residency=None):
             # service scheduler: serialize the device-bound phases across
             # worker threads while staging/parse overlap
             device_token=getattr(ctx, "device_token", None),
+            # cooperative cancellation: the job checks this at phase and
+            # checkpoint-group boundaries (utils/cancel.py)
+            cancel=getattr(ctx, "cancel", None),
         ).run(clean=bool(msg.get("clean")))
 
     return cb

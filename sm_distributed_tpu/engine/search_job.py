@@ -20,6 +20,7 @@ from pathlib import Path
 
 from ..io.dataset import SpectralDataset
 from ..models.msm_basic import IsotopePrefetch, MSMBasicSearch, SearchResultsBundle
+from ..utils.cancel import JobCancelledError, hold_cancellable
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger, phase_timer
 from .moldb import MolecularDB
@@ -41,6 +42,7 @@ class SearchJob:
         profile_dir: str | None = None,
         residency=None,
         device_token=None,
+        cancel=None,
     ):
         self.ds_id = ds_id
         self.ds_name = ds_name
@@ -59,6 +61,11 @@ class SearchJob:
         # the device-bound compile+search+store phase of concurrent jobs
         # serializes here while their staging/parse phases overlap
         self.device_token = device_token
+        # cooperative cancellation (utils/cancel.CancelToken): checked at
+        # phase boundaries here and at checkpoint-group boundaries inside
+        # the search, so a timed-out/cancelled job releases the device
+        # token and stores no partial results
+        self.cancel = cancel
         self.ledger = JobLedger(self.sm_config.storage.results_dir)
         # generation stats of the last completed run (workers, patterns/s,
         # device flag) — read by probes/benches (scripts/cold_path_bench.py)
@@ -96,14 +103,20 @@ class SearchJob:
             # of the BASELINE #3 wall) — start it FIRST, so staging + parse
             # below overlap it instead of queueing behind it
             formulas = self._load_formulas()
+            if self.cancel is not None:
+                self.cancel.check("load_formulas")
             if self.sm_config.parallel.overlap_isocalc != "off":
                 prefetch = IsotopePrefetch(
                     formulas, self.ds_config, self.sm_config,
                     str(Path(self.sm_config.work_dir) / "isocalc_cache"))
             with phase_timer("stage_input", timings):
                 self.work_dir.copy_input_data(self.input_path)
+            if self.cancel is not None:
+                self.cancel.check("stage_input")
             with phase_timer("read_dataset", timings):
                 ds = self._read_dataset()
+            if self.cancel is not None:
+                self.cancel.check("read_dataset")
             logger.info(
                 "dataset %s: %dx%d px, %d spectra, %d peaks",
                 self.ds_id, ds.nrows, ds.ncols, ds.n_spectra, ds.n_peaks,
@@ -118,8 +131,14 @@ class SearchJob:
             # everything up to here is CPU-bound (staging, parse, formula
             # lookup) and overlaps freely across scheduler workers; from the
             # backend build through result storage the device is involved,
-            # so concurrent service jobs serialize on the TPU token
-            token = self.device_token or contextlib.nullcontext()
+            # so concurrent service jobs serialize on the TPU token.  The
+            # acquisition stays cancellable: a cancelled job must not sit in
+            # the device queue, and the ``with`` exit releases the token on
+            # the cooperative JobCancelledError unwind.
+            if self.device_token is None and self.cancel is None:
+                token = contextlib.nullcontext()
+            else:
+                token = hold_cancellable(self.device_token, self.cancel)
             with token:
                 search = MSMBasicSearch(
                     ds, formulas, self.ds_config, self.sm_config,
@@ -127,6 +146,7 @@ class SearchJob:
                     checkpoint_dir=str(self.work_dir.path),
                     backend_cache=self.residency,
                     prefetch=prefetch,
+                    cancel=self.cancel,
                 )
                 prefetch = None   # ownership passed: search() consumes/cancels
                 bundle = search.search()
@@ -139,6 +159,10 @@ class SearchJob:
                     prof = None
                     logger.info("profile trace written to %s", self.profile_dir)
                 bundle.timings.update(timings)
+                if self.cancel is not None:
+                    # last cooperative gate before results become durable: a
+                    # cancelled/expired job must store NOTHING partial
+                    self.cancel.check("store_results")
                 with phase_timer("store_results", bundle.timings):
                     ion_mzs = {
                         (table_sf, table_ad): mz
@@ -185,7 +209,10 @@ class SearchJob:
             # remove THIS job's partial index entries (the reference's ES
             # cleanup [U]); earlier successful jobs' rows stay queryable
             self.store.index.delete_ds(self.ds_id, job_id=job_id)
-            logger.error("job %d FAILED: %s", job_id, exc)
+            if isinstance(exc, JobCancelledError):
+                logger.info("job %d CANCELLED: %s", job_id, exc)
+            else:
+                logger.error("job %d FAILED: %s", job_id, exc)
             raise
         finally:
             # on failure the work dir survives even with clean=True: it holds

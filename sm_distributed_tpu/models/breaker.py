@@ -1,0 +1,167 @@
+"""Device-backend circuit breaker (ISSUE 4 degradation layer).
+
+A flaky device backend — preempted TPU, dying tunnel, XLA launch failures —
+used to be retried forever by the scheduler's failure policy, burning every
+attempt of every job on the same broken path.  The breaker wraps the device
+scoring seam in ``MSMBasicSearch._score_and_rank``:
+
+- **closed**: device scoring as normal; each cleanly scored group counts as
+  a success and resets the consecutive-error count;
+- **open**: after ``service.breaker_threshold`` consecutive device errors.
+  Jobs score on the numpy oracle at ``service.breaker_degraded_batch``
+  instead (metrics are backend-independent, so results are bit-identical to
+  a healthy numpy run) — degraded but correct beats dead;
+- **half-open**: once ``service.breaker_cooldown_s`` has elapsed, the next
+  job's device build is allowed through as a probe.  A clean group closes
+  the breaker; another device error re-opens it and restarts the cooldown.
+
+The breaker is a process-global singleton (one device per process — the
+scheduler's TPU token already serializes device phases), shared across the
+service's jobs so one job's failures protect the next.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..utils.logger import logger
+
+STATE_CLOSED = "closed"
+STATE_OPEN = "open"
+STATE_HALF_OPEN = "half_open"
+_STATE_CODE = {STATE_CLOSED: 0, STATE_HALF_OPEN: 1, STATE_OPEN: 2}
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with half-open recovery probes."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 30.0):
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        self._state = STATE_CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        # (monotonic time, from, to) — bounded history for probes/tests
+        self.transitions: list[tuple[float, str, str]] = []
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def _transition(self, to: str) -> None:
+        # callers hold self._lock
+        if self._state == to:
+            return
+        self.transitions.append((time.monotonic(), self._state, to))
+        if len(self.transitions) > 256:
+            del self.transitions[:-256]
+        logger.warning("device breaker: %s -> %s (%d consecutive failures)",
+                       self._state, to, self._failures)
+        self._state = to
+        _export_state(to)
+
+    def allow_device(self) -> bool:
+        """May the next job use the device backend?  In OPEN state this
+        flips to HALF_OPEN once the cooldown has elapsed and admits that one
+        caller as the recovery probe."""
+        with self._lock:
+            if self._state == STATE_CLOSED or self._state == STATE_HALF_OPEN:
+                return True
+            if time.monotonic() - self._opened_at >= self.cooldown_s:
+                self._transition(STATE_HALF_OPEN)
+                return True
+            return False
+
+    def record_success(self) -> None:
+        """A device scoring group completed cleanly."""
+        with self._lock:
+            self._failures = 0
+            if self._state != STATE_CLOSED:
+                self._transition(STATE_CLOSED)
+
+    def record_failure(self) -> bool:
+        """A device error occurred; returns True when the breaker is now
+        open (callers degrade to the numpy fallback)."""
+        with self._lock:
+            self._failures += 1
+            if self._state == STATE_HALF_OPEN or (
+                    self._state == STATE_CLOSED
+                    and self._failures >= self.threshold):
+                self._opened_at = time.monotonic()
+                self._transition(STATE_OPEN)
+            elif self._state == STATE_OPEN:
+                self._opened_at = time.monotonic()
+            return self._state == STATE_OPEN
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self._state, "failures": self._failures,
+                    "threshold": self.threshold,
+                    "cooldown_s": self.cooldown_s}
+
+
+# ------------------------------------------------------- process singleton
+_lock = threading.Lock()
+_breaker: CircuitBreaker | None = None
+_metrics = None
+
+
+def get_device_breaker(service_cfg=None) -> CircuitBreaker:
+    """The process-global breaker.  ``service_cfg`` (a ``ServiceConfig``)
+    refreshes the thresholds in place — the state machine is untouched, so
+    a service and its jobs reading the same config always agree."""
+    global _breaker
+    with _lock:
+        if _breaker is None:
+            _breaker = CircuitBreaker()
+        if service_cfg is not None:
+            _breaker.threshold = int(service_cfg.breaker_threshold)
+            _breaker.cooldown_s = float(service_cfg.breaker_cooldown_s)
+        return _breaker
+
+
+def reset_device_breaker() -> None:
+    """Fresh breaker + detach metrics (tests)."""
+    global _breaker, _metrics
+    with _lock:
+        _breaker = None
+        _metrics = None
+
+
+def _export_state(state: str) -> None:
+    m = _metrics
+    if m is None:
+        return
+    m.gauge("sm_breaker_state",
+            "Device breaker state (0=closed, 1=half_open, 2=open)").set(
+        _STATE_CODE[state])
+    m.counter("sm_breaker_transitions_total",
+              "Device breaker state transitions, by destination",
+              ("to",)).labels(to=state).inc()
+
+
+def attach_metrics(registry) -> None:
+    """Export breaker state through a service ``MetricsRegistry``:
+    ``sm_breaker_state`` gauge + ``sm_breaker_transitions_total{to=}`` and
+    a degraded-scoring counter (incremented by the scoring seam)."""
+    global _metrics
+    with _lock:
+        _metrics = registry
+        b = _breaker
+    registry.gauge("sm_breaker_state",
+                   "Device breaker state (0=closed, 1=half_open, 2=open)").set(
+        _STATE_CODE[b.state if b is not None else STATE_CLOSED])
+    registry.counter("sm_breaker_transitions_total",
+                     "Device breaker state transitions, by destination", ("to",))
+    registry.counter("sm_breaker_degraded_total",
+                     "Scoring runs degraded to the numpy fallback")
+
+
+def record_degraded() -> None:
+    m = _metrics
+    if m is not None:
+        m.counter("sm_breaker_degraded_total",
+                  "Scoring runs degraded to the numpy fallback").inc()

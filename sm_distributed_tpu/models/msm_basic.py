@@ -27,9 +27,11 @@ from ..ops.isocalc import (
     IsocalcWrapper,
     IsotopePatternTable,
 )
+from ..utils.cancel import JobCancelledError
 from ..utils.config import DSConfig, SMConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger, phase_timer
+from .breaker import get_device_breaker, record_degraded
 
 FP_SHARD_WRITE = register_failpoint(
     "ckpt.shard_write",
@@ -39,6 +41,10 @@ FP_SHARD_LOAD = register_failpoint(
 FP_DEVICE_SCORE = register_failpoint(
     "device.score_batch",
     "before scoring a batch group (TPU preemption / XLA failure mid-search)")
+FP_DEVICE_ERROR = register_failpoint(
+    "backend.device_error",
+    "inside a device score_batches call — the consecutive-error seam the "
+    "circuit breaker counts (open -> degrade to numpy -> half-open probe)")
 
 
 def _slice_table(table: IsotopePatternTable, s: int, e: int) -> IsotopePatternTable:
@@ -104,10 +110,17 @@ class NumpyBackend:
         # intensity grid (exact cross-backend image parity)
         self._view = SortedPeakView.prepare(ds, ds_config.image_generation.ppm)
 
-    def score_batches(self, tables) -> list[np.ndarray]:
+    def score_batches(self, tables, cancel=None) -> list[np.ndarray]:
         """Score an iterable of batches one at a time (no pipelining on CPU;
-        accepts a lazy generator so only one slice is live at once)."""
-        return [self.score_batch(t) for t in tables]
+        accepts a lazy generator so only one slice is live at once).
+        ``cancel`` is checked between batches — the host path's finest
+        cooperative-cancellation grain."""
+        out = []
+        for t in tables:
+            if cancel is not None:
+                cancel.check("score_batch")
+            out.append(self.score_batch(t))
+        return out
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         """(n_ions, 4) array of (chaos, spatial, spectral, msm)."""
@@ -334,6 +347,7 @@ class MSMBasicSearch:
         checkpoint_dir: str | None = None,
         backend_cache=None,
         prefetch: IsotopePrefetch | None = None,
+        cancel=None,
     ):
         self.ds = ds
         self.formulas = list(dict.fromkeys(formulas))  # dedup, keep order
@@ -348,6 +362,10 @@ class MSMBasicSearch:
         # isocalc already running — search() consumes its stream instead of
         # starting one
         self.prefetch = prefetch
+        # cooperative cancellation (utils/cancel.CancelToken or None):
+        # checked at checkpoint-group boundaries and inside the host
+        # backend's per-batch loop
+        self.cancel = cancel
         self.isocalc = None if prefetch is not None else make_isocalc(
             ds_config, self.sm_config, isocalc_cache_dir)
         # populated by search(); the orchestrator reads these to persist ion
@@ -435,6 +453,73 @@ class MSMBasicSearch:
     _ALL_COLUMNS = ["sf", "adduct", "is_target", "chaos", "spatial",
                     "spectral", "msm"]
 
+    def _reduced_slices(self, group: list[tuple[int, int]]) -> list[tuple[int, int]]:
+        """Re-split a checkpoint group's batch slices at the degraded
+        (breaker-open) batch size.  Group row ranges — and therefore the
+        checkpoint partition — are untouched; only the host scoring grain
+        shrinks."""
+        cap = max(1, self.sm_config.service.breaker_degraded_batch)
+        return [(a, min(a + cap, e))
+                for s, e in group for a in range(s, e, cap)]
+
+    def _score_group(self, backend, table, metrics: np.ndarray,
+                     group: list[tuple[int, int]], breaker, use_device: bool,
+                     degraded: bool):
+        """Score one checkpoint group through the circuit breaker.  Device
+        errors feed ``record_failure``; below threshold they fail the
+        attempt (the retry may find a healthy device), at threshold the
+        breaker OPENS and this group — and the rest of the job — degrades
+        in place to the numpy oracle at reduced batch.  Metrics are
+        backend-independent (bit-exact parity), so a mid-job switch is
+        invisible in the results.  Returns the (possibly swapped) backend
+        and degraded flag."""
+        on_device = use_device and not degraded
+        slices = self._reduced_slices(group) if degraded else group
+        try:
+            if on_device:
+                # injected consecutive-device-error seam (chaos sweep:
+                # breaker opens mid-job, degrades, converges to golden)
+                failpoint(FP_DEVICE_ERROR)
+            # lazy slices: every backend exposes score_batches; the jax
+            # one pipelines (async-enqueues all batches in the group
+            # before syncing any), the numpy one consumes one at a time
+            outs = backend.score_batches(
+                (_slice_table(table, s, e) for s, e in slices),
+                cancel=self.cancel)
+        except JobCancelledError:
+            raise
+        except Exception as exc:
+            injected = "backend.device_error" in str(exc)
+            if not (on_device or injected):
+                raise                 # a host-backend bug is not a device fault
+            now_open = breaker.record_failure()
+            logger.warning(
+                "device error while scoring (breaker %s after it): %s",
+                breaker.state, exc)
+            if not now_open:
+                raise                 # below threshold: let the retry policy
+                                      # probe the device again
+            record_degraded()
+            logger.warning(
+                "device breaker opened mid-job: degrading to the numpy "
+                "backend at batch %d",
+                self.sm_config.service.breaker_degraded_batch)
+            backend = NumpyBackend(self.ds, self.ds_config)
+            self.last_backend = backend
+            degraded = True
+            slices = self._reduced_slices(group)
+            outs = backend.score_batches(
+                (_slice_table(table, s, e) for s, e in slices),
+                cancel=self.cancel)
+        else:
+            if on_device:
+                # a cleanly scored device group closes a half-open probe
+                # and resets the consecutive-error count
+                breaker.record_success()
+        for (s, e), out in zip(slices, outs):
+            metrics[s:e] = out
+        return backend, degraded
+
     def search(self) -> SearchResultsBundle:
         timings: dict[str, float] = {}
         if not self.formulas:
@@ -504,7 +589,22 @@ class MSMBasicSearch:
                 self.sm_config, table=table,
             )
 
-        if self.backend_cache is not None:
+        # device circuit breaker (models/breaker.py): an OPEN breaker means
+        # the device backend recently produced N consecutive errors — skip
+        # the build/compile entirely and score on the numpy oracle at
+        # reduced batch (bit-identical results; degraded-but-correct beats
+        # dead).  allow_device() admits one half-open probe after cooldown.
+        use_device = self.sm_config.backend == "jax_tpu"
+        breaker = get_device_breaker(self.sm_config.service)
+        degraded = False
+        if use_device and not breaker.allow_device():
+            logger.warning(
+                "device breaker open: degrading job to the numpy backend "
+                "at batch %d", self.sm_config.service.breaker_degraded_batch)
+            record_degraded()
+            backend = NumpyBackend(self.ds, self.ds_config)
+            degraded = True
+        elif self.backend_cache is not None:
             par = self.sm_config.parallel
             key = (self.sm_config.backend, fingerprint,
                    par.mz_chunk, par.pixels_axis, par.formulas_axis,
@@ -556,19 +656,28 @@ class MSMBasicSearch:
             for gi, group in enumerate(groups):
                 if gi < done:
                     continue
+                if self.cancel is not None:
+                    # THE cooperative cancellation boundary: a timed-out /
+                    # deleted / past-deadline job unwinds here, after the
+                    # last durable checkpoint and before any new work
+                    self.cancel.check("score")
                 if overlap:
-                    # block until this group's pattern rows are published
-                    stream.wait_rows(row_ranges[gi][1])
+                    # block until this group's pattern rows are published —
+                    # in bounded slices so a cancel still lands while
+                    # generation is the laggard
+                    need = row_ranges[gi][1]
+                    if self.cancel is None:
+                        stream.wait_rows(need)
+                    else:
+                        while stream.wait_rows(need, timeout=0.2) < min(
+                                need, stream.n_ions):
+                            self.cancel.check("isotope_patterns_wait")
                 # device-fault seam: a preempted TPU / failed XLA launch
                 # surfaces here, after `done` groups are already durable
                 failpoint(FP_DEVICE_SCORE)
-                # lazy slices: every backend exposes score_batches; the jax
-                # one pipelines (async-enqueues all batches in the group
-                # before syncing any), the numpy one consumes one at a time
-                outs = backend.score_batches(
-                    _slice_table(table, s, e) for s, e in group)
-                for (s, e), out in zip(group, outs):
-                    metrics[s:e] = out
+                backend, degraded = self._score_group(
+                    backend, table, metrics, group, breaker, use_device,
+                    degraded)
                 if ckpt is not None:
                     ckpt.save(metrics, gi, len(groups), row_ranges)
             # NOT finalized here: downstream FDR/storage can still fail, and
@@ -582,6 +691,8 @@ class MSMBasicSearch:
                 # last row) and surface any late stream error before FDR
                 stream.result_table()
         timings["isocalc_gen"] = stream.gen_seconds
+        if self.cancel is not None:
+            self.cancel.check("fdr")
         with phase_timer("fdr", timings):
             all_df = pd.DataFrame(
                 {

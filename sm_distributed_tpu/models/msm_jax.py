@@ -896,11 +896,16 @@ class JaxBackend:
             logger.warning("could not write warmup manifest %s", path,
                            exc_info=True)
 
-    def score_batches(self, tables) -> list[np.ndarray]:
+    def score_batches(self, tables, cancel=None) -> list[np.ndarray]:
         """Pipelined scoring: enqueue every batch before syncing any result
         (JAX dispatch is async, so device compute of all batches overlaps the
-        ~0.3 ms/batch host prep), then fetch all results concurrently."""
+        ~0.3 ms/batch host prep), then fetch all results concurrently.
+        ``cancel`` (utils/cancel.CancelToken) is checked once before the
+        group enqueues — the device pipeline is all-or-nothing, so the
+        cooperative boundary is the checkpoint group."""
         tables = list(tables)
+        if cancel is not None:
+            cancel.check("score_batches")
         if self.mz_chunk:
             return fetch_scored_batches([self._dispatch(t) for t in tables])
         # plan every batch up front: pre-sizes the static shapes (band width,

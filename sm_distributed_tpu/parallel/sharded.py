@@ -535,15 +535,20 @@ class ShardedJaxBackend:
         imgs[~valid] = 0.0
         return imgs
 
-    def score_batches(self, tables) -> list[np.ndarray]:
+    def score_batches(self, tables, cancel=None) -> list[np.ndarray]:
         """Pipelined like the single-device backend: every batch enqueued
         (async dispatch + sharded device_put) before any result is synced;
         results fetched concurrently (models/msm_jax.fetch_scored_batches).
         Plans are built up front so the band width (and hence the ONE
-        executable) is fixed before the first dispatch."""
+        executable) is fixed before the first dispatch.  ``cancel`` is
+        checked once before the group enqueues (checkpoint-group grain —
+        multi-host collectives must stay in lockstep, so no per-batch
+        bail-out mid-pipeline)."""
         from ..models.msm_jax import fetch_scored_batches
 
         tables = list(tables)
+        if cancel is not None:
+            cancel.check("score_batches")
         plans = [self._flat_plan(t) for t in tables]
         self._grow_static_shapes(plans)
         return fetch_scored_batches(

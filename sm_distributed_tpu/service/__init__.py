@@ -7,25 +7,37 @@ shape the ROADMAP north star asks for on top of the same spool contract:
 - ``scheduler``  — concurrent job scheduler: worker pool draining the spool,
   priority classes + per-tenant fairness, device-bound phases serialized via
   a TPU token while CPU staging/parse overlap;
-- ``scheduler``  — failure policy: per-job timeout, retry with exponential
-  backoff + jitter, bounded attempts, dead-letter into ``failed/`` with the
-  recorded traceback, heartbeat files for crash-vs-slow discrimination;
+- ``scheduler``  — failure policy: per-job timeout with COOPERATIVE
+  cancellation (``utils/cancel.CancelToken`` threaded through the job,
+  checked at checkpoint-group boundaries), retry with exponential backoff +
+  jitter, bounded attempts, dead-letter into ``failed/`` with the recorded
+  traceback, heartbeat files for crash-vs-slow discrimination, deadline
+  propagation, a stall watchdog, and crash-loop quarantine;
+- ``admission``  — overload protection for ``POST /submit``: bounded queue
+  depth, per-tenant quotas, EWMA latency shedding with hysteresis —
+  structured 429/503 + ``Retry-After`` instead of an unbounded backlog;
 - ``metrics``    — counters/gauges/histograms with Prometheus text
   exposition, threaded through ``phase_timer`` and ``DatasetResidency``;
 - ``api``        — stdlib ``http.server`` admin API (``/healthz``,
-  ``/metrics``, ``/jobs``, ``POST /submit``);
-- ``server``     — ``AnnotationService`` composing all of the above with
-  graceful SIGTERM shutdown (drain running, requeue claimed-but-unstarted).
+  ``/metrics``, ``/jobs``, ``POST /submit``, ``DELETE /jobs/<id>``);
+- ``server``     — ``AnnotationService`` composing all of the above (plus
+  the device circuit breaker, ``models/breaker.py``) with graceful SIGTERM
+  shutdown (drain running, requeue claimed-but-unstarted).
+
+The overload/degradation layer is proven end to end by
+``scripts/load_sweep.py`` (docs/SERVICE.md "Overload & degradation model").
 
 Everything here is exercisable on CPU (``JAX_PLATFORMS=cpu``) with fake job
 callbacks — see ``tests/test_service.py``.
 """
 
+from .admission import AdmissionController
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .scheduler import JobRecord, JobScheduler, RetryPolicy
 from .server import AnnotationService
 
 __all__ = [
+    "AdmissionController",
     "AnnotationService",
     "Counter",
     "Gauge",

@@ -3,18 +3,29 @@
 Endpoints (the reference exposes none of this; operators had to shell into
 RabbitMQ's management UI):
 
-- ``GET /healthz``   liveness + spool depths; 200 while serving, 503 once
-  shutdown has begun (load balancers stop routing before the drain ends);
+- ``GET /healthz``   liveness + spool depths + admission state; 200 while
+  serving, 503 once shutdown has begun (load balancers stop routing before
+  the drain ends);
 - ``GET /metrics``   Prometheus text exposition from the service registry;
 - ``GET /jobs``      JSON array of the scheduler's job records (filter with
   ``?state=running`` etc.);
 - ``POST /submit``   body = a spool message (``ds_id`` + ``input_path`` at
-  minimum, optional ``priority``/``tenant``/``service.timeout_s``); returns
-  ``{"msg_id": ...}`` 202.  Publishing goes through ``QueuePublisher`` so a
-  submitted job is durable before the response leaves.
+  minimum, optional ``priority``/``tenant``/``deadline_s``/
+  ``service.timeout_s``); returns ``{"msg_id": ...}`` 202.  Publishing goes
+  through ``QueuePublisher`` so a submitted job is durable before the
+  response leaves.  Overload protection sits in front: a shed submit gets a
+  structured **429** (``queue_full`` / ``tenant_quota``) or **503**
+  (``latency_overload`` / draining) with a ``Retry-After`` header and a
+  JSON body naming the reason (``service/admission.py``).  Malformed
+  payloads get a structured **400**, never a traceback;
+- ``DELETE /jobs/<id>``  cooperative cancel: a queued message terminates
+  immediately, a running attempt unwinds at its next checkpoint boundary
+  (``utils/cancel.py``); 202 while cancelling, 200 when already terminal-
+  cancelled here, 409 for finished jobs, 404 for unknown ids.
 
 ``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
-handler is read-only except ``/submit``, which only appends to ``pending/``.
+handler is read-only except ``/submit`` (appends to ``pending/``) and
+``DELETE /jobs/<id>`` (cancels one message).
 """
 
 from __future__ import annotations
@@ -26,6 +37,48 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..utils.logger import logger
+
+# message fields /submit validates beyond the publisher's ds_id/input_path
+# requirement: (field, predicate, expectation) — anything else passes
+# through untouched (the spool message schema is open)
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_submit(msg) -> list[str]:
+    """Structural validation for a /submit payload; returns problem list
+    (empty = valid).  Catches the malformed shapes that used to surface as
+    a 500 traceback deep inside the scheduler."""
+    if not isinstance(msg, dict):
+        return ["message must be a JSON object"]
+    errs = []
+    for req in ("ds_id", "input_path"):
+        v = msg.get(req)
+        if not isinstance(v, str) or not v:
+            errs.append(f"{req!r} is required and must be a non-empty string")
+    for name in ("tenant", "ds_name"):
+        if name in msg and not isinstance(msg[name], str):
+            errs.append(f"{name!r} must be a string")
+    if "priority" in msg and not (
+            isinstance(msg["priority"], (int, str))
+            and not isinstance(msg["priority"], bool)):
+        errs.append("'priority' must be a string class or an int rank")
+    if "deadline_s" in msg:
+        if not _is_num(msg["deadline_s"]) or msg["deadline_s"] <= 0:
+            errs.append("'deadline_s' must be a positive number of seconds")
+    svc = msg.get("service", {})
+    if not isinstance(svc, dict):
+        errs.append("'service' must be an object")
+    else:
+        for name in ("timeout_s", "deadline_s", "deadline_at"):
+            if name in svc and (not _is_num(svc[name]) or svc[name] <= 0):
+                errs.append(f"'service.{name}' must be a positive number")
+        if "max_attempts" in svc and not (
+                isinstance(svc["max_attempts"], int)
+                and not isinstance(svc["max_attempts"], bool)
+                and svc["max_attempts"] > 0):
+            errs.append("'service.max_attempts' must be a positive integer")
+    return errs
 
 
 class AdminAPI:
@@ -41,16 +94,20 @@ class AdminAPI:
             def log_message(self, fmt, *args):  # route access logs to ours
                 logger.debug("admin-api: " + fmt, *args)
 
-            def _reply(self, status: int, body: bytes, ctype: str) -> None:
+            def _reply(self, status: int, body: bytes, ctype: str,
+                       headers: dict | None = None) -> None:
                 self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _reply_json(self, status: int, obj) -> None:
+            def _reply_json(self, status: int, obj,
+                            headers: dict | None = None) -> None:
                 self._reply(status, json.dumps(obj).encode(),
-                            "application/json")
+                            "application/json", headers)
 
             def do_GET(self):
                 try:
@@ -77,22 +134,35 @@ class AdminAPI:
                     if urlparse(self.path).path != "/submit":
                         self._reply_json(404, {"error": "not found"})
                         return
-                    n = int(self.headers.get("Content-Length", 0))
-                    raw = self.rfile.read(n) if n else b""
-                    try:
-                        msg = json.loads(raw or b"{}")
-                        if not isinstance(msg, dict):
-                            raise ValueError("message must be a JSON object")
-                        dst = api.service.publisher.publish(msg)
-                    except (ValueError, json.JSONDecodeError) as exc:
-                        self._reply_json(400, {"error": str(exc)})
-                        return
-                    self._reply_json(202, {"msg_id": dst.stem,
-                                           "spooled": str(dst)})
+                    status, body, headers = api._submit(self._read_body())
+                    self._reply_json(status, body, headers)
                 except Exception as exc:  # noqa: BLE001
                     logger.error("admin-api: POST %s failed", self.path,
                                  exc_info=True)
                     self._reply_json(500, {"error": str(exc)})
+
+            def do_DELETE(self):
+                try:
+                    parts = urlparse(self.path).path.strip("/").split("/")
+                    if len(parts) != 2 or parts[0] != "jobs":
+                        self._reply_json(
+                            404, {"error": "not found",
+                                  "reason": "want DELETE /jobs/<msg_id>"})
+                        return
+                    if not parts[1]:
+                        self._reply_json(400, {"error": "missing msg_id",
+                                               "reason": "invalid_request"})
+                        return
+                    status, body = api._cancel(parts[1])
+                    self._reply_json(status, body)
+                except Exception as exc:  # noqa: BLE001
+                    logger.error("admin-api: DELETE %s failed", self.path,
+                                 exc_info=True)
+                    self._reply_json(500, {"error": str(exc)})
+
+            def _read_body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return self.rfile.read(n) if n else b""
 
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self._server.daemon_threads = True
@@ -109,6 +179,9 @@ class AdminAPI:
             "jobs": stats["states"],
             "queue": svc.queue_depths(),
         }
+        adm = getattr(svc, "admission", None)
+        if adm is not None:
+            body["admission"] = adm.stats()
         return body, (503 if stats["stopping"] else 200)
 
     def _jobs(self, state: str | None) -> list[dict]:
@@ -116,6 +189,56 @@ class AdminAPI:
         if state:
             jobs = [j for j in jobs if j["state"] == state]
         return jobs
+
+    def _submit(self, raw: bytes) -> tuple[int, dict, dict | None]:
+        """Validate → admit → publish; returns (status, body, headers)."""
+        svc = self.service
+        try:
+            msg = json.loads(raw or b"{}")
+        except json.JSONDecodeError as exc:
+            return 400, {"error": f"malformed JSON: {exc}",
+                         "reason": "invalid_json"}, None
+        errs = validate_submit(msg)
+        if errs:
+            return 400, {"error": "; ".join(errs),
+                         "reason": "invalid_message"}, None
+        if svc.stopping():
+            return 503, {"error": "service is draining",
+                         "reason": "stopping", "retry_after_s": 5.0}, \
+                {"Retry-After": "5"}
+        tenant = str(msg.get("tenant", "default"))
+        adm = getattr(svc, "admission", None)
+        decision = adm.try_admit(tenant) if adm is not None else None
+        if decision is not None and not decision.accepted:
+            return decision.status, decision.body(), \
+                {"Retry-After": str(max(1, int(round(decision.retry_after_s))))}
+        try:
+            # deadline propagation: pin the ABSOLUTE deadline at submit time
+            # so queueing delay counts against it end to end
+            if "deadline_s" in msg:
+                service_block = dict(msg.get("service", {}))
+                service_block.setdefault(
+                    "deadline_at", time.time() + float(msg["deadline_s"]))
+                msg["service"] = service_block
+            dst = svc.publisher.publish(msg)
+        except (ValueError, OSError) as exc:
+            if decision is not None:
+                adm.abort(tenant)
+            return 400, {"error": str(exc), "reason": "invalid_message"}, None
+        if decision is not None:
+            adm.confirm(dst.stem, tenant)
+        return 202, {"msg_id": dst.stem, "spooled": str(dst)}, None
+
+    def _cancel(self, msg_id: str) -> tuple[int, dict]:
+        disposition = self.service.scheduler.cancel(msg_id)
+        status = {"cancelling": 202, "cancelled": 200,
+                  "terminal": 409, "not_found": 404}[disposition]
+        body = {"msg_id": msg_id, "state": disposition}
+        if disposition == "terminal":
+            body["error"] = "job already reached a terminal state"
+        elif disposition == "not_found":
+            body["error"] = "unknown msg_id"
+        return status, body
 
     # ------------------------------------------------------------ lifecycle
     @property

@@ -19,6 +19,21 @@ Replaces the daemon's one-message-at-a-time blocking loop
   Retries persist their state (``attempts``, ``next_retry_at``) INTO the
   message file and move it back to ``pending/`` — a scheduler crash between
   attempts loses nothing;
+- **cooperative cancellation** (``utils/cancel.py``): every attempt gets a
+  ``CancelToken`` via ``JobContext``.  A per-attempt timeout, an absolute
+  submit deadline (``service.deadline_at``), an operator ``DELETE
+  /jobs/<id>``, or the stall **watchdog** trips the token; the job unwinds
+  at its next checkpoint-group boundary — releasing the device token and
+  writing no partial results — and the worker requeues or terminates the
+  message cleanly.  Only an attempt that ignores the cancel past
+  ``cancel_grace_s`` is abandoned (counted on ``/metrics``); spool moves
+  still only ever happen in the owning worker, so even a zombie can never
+  corrupt queue state;
+- **quarantine**: every claim increments a persisted ``service.claims``
+  counter, so a message that crash-loops the process (and therefore never
+  reaches the handled-failure/dead-letter path) moves to a ``quarantine/``
+  spool state after ``quarantine_after`` claims instead of cycling through
+  requeue forever;
 - **heartbeat files** (``engine/daemon.py::ClaimHeartbeat``) touched for
   every running claim, so ``requeue_stale()`` distinguishes crashed claims
   from slow jobs;
@@ -52,6 +67,7 @@ from ..engine.daemon import (
     clear_heartbeat,
     sweep_orphan_tmp,
 )
+from ..utils.cancel import CancelToken, DeadlineExceededError, JobCancelledError
 from ..utils.config import ServiceConfig
 from ..utils.failpoints import failpoint, register_failpoint
 from ..utils.logger import logger
@@ -59,11 +75,17 @@ from ..utils.logger import logger
 FP_RETRY_PUBLISH = register_failpoint(
     "sched.retry_publish",
     "between a retry's updated tmp write and its republish into pending/")
+FP_CANCEL_DELIVER = register_failpoint(
+    "sched.cancel_deliver",
+    "between a cancel decision (timeout/deadline/user/watchdog) and its "
+    "delivery to the attempt's CancelToken")
 
 PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
 
 # terminal + live job states surfaced via /jobs
-JOB_STATES = ("queued", "claimed", "running", "retry_wait", "done", "failed")
+JOB_STATES = ("queued", "claimed", "running", "retry_wait", "done", "failed",
+              "cancelled", "quarantined")
+TERMINAL_STATES = ("done", "failed", "cancelled", "quarantined")
 
 
 def _priority_rank(value) -> int:
@@ -114,6 +136,8 @@ class JobRecord:
     started_at: float = 0.0
     finished_at: float = 0.0
     next_retry_at: float = 0.0
+    deadline_at: float = 0.0
+    cancel_requested: str = ""     # "" | "user" (DELETE /jobs/<id>)
     error: str = ""
 
     def to_dict(self) -> dict:
@@ -123,7 +147,9 @@ class JobRecord:
             "attempts": self.attempts, "published_at": self.published_at,
             "claimed_at": self.claimed_at, "started_at": self.started_at,
             "finished_at": self.finished_at,
-            "next_retry_at": self.next_retry_at, "error": self.error,
+            "next_retry_at": self.next_retry_at,
+            "deadline_at": self.deadline_at,
+            "cancel_requested": self.cancel_requested, "error": self.error,
         }
 
 
@@ -135,6 +161,9 @@ class JobContext:
     attempt: int
     device_token: threading.Lock = field(repr=False, default=None)
     metrics: object = field(repr=False, default=None)
+    # cooperative cancellation: callbacks check this at phase / checkpoint-
+    # group boundaries (utils/cancel.CancelToken; None for legacy callers)
+    cancel: object = field(repr=False, default=None)
 
 
 def _callback_takes_ctx(fn) -> bool:
@@ -157,8 +186,10 @@ def _callback_takes_ctx(fn) -> bool:
 
 class _Attempt(threading.Thread):
     """One callback invocation, joinable with a timeout.  A timed-out
-    attempt thread is abandoned (daemon thread — Python cannot kill it);
-    all spool file moves happen in the owning worker, so a zombie attempt
+    attempt is cancelled cooperatively through its ``JobContext.cancel``
+    token and given ``cancel_grace_s`` to unwind; only one that ignores the
+    cancel is abandoned (daemon thread — Python cannot kill it).  All spool
+    file moves happen in the owning worker, so even an abandoned attempt
     can never corrupt queue state."""
 
     def __init__(self, fn, msg, ctx, takes_ctx: bool):
@@ -188,6 +219,7 @@ class JobScheduler:
         config: ServiceConfig | None = None,
         queue: str = QUEUE_ANNOTATE,
         metrics=None,
+        admission=None,
     ):
         self.root = Path(queue_dir) / queue
         for s in _STATES:
@@ -197,10 +229,16 @@ class JobScheduler:
         self.cfg = config or ServiceConfig()
         self.retry = RetryPolicy.from_config(self.cfg)
         self.metrics = metrics
+        # service-level admission controller (service/admission.py): the
+        # scheduler reports terminal outcomes + attempt latency into it
+        self.admission = admission
         # ONE token: device-bound phases of concurrent jobs serialize here
         self.device_token = threading.Lock()
         self._records: dict[str, JobRecord] = {}
         self._records_lock = threading.Lock()
+        # live attempts by msg_id: (CancelToken, _Attempt) — the seam the
+        # DELETE endpoint and the stall watchdog deliver cancels through
+        self._live: dict[str, tuple[CancelToken, _Attempt]] = {}
         # bounded hand-off: at most `workers` messages sit claimed-but-
         # unstarted, so a SIGTERM drain requeues a bounded set
         self._handoff: _queue_mod.Queue = _queue_mod.Queue(maxsize=max(1, self.cfg.workers))
@@ -220,7 +258,16 @@ class JobScheduler:
         self.m_retries = m.counter(
             "sm_job_retries_total", "Retry attempts scheduled")
         self.m_timeouts = m.counter(
-            "sm_job_timeouts_total", "Attempts killed by the per-job timeout")
+            "sm_job_timeouts_total", "Attempts that exceeded the per-job timeout")
+        self.m_cancels = m.counter(
+            "sm_jobs_cancelled_total", "Cancellations delivered, by reason",
+            ("reason",))
+        self.m_abandoned = m.counter(
+            "sm_job_abandoned_total",
+            "Timed-out attempts still alive after the cancel grace period")
+        self.m_quarantined = m.counter(
+            "sm_jobs_quarantined_total",
+            "Messages parked in quarantine/ after crash-looping claims")
         self.m_running = m.gauge(
             "sm_jobs_running", "Jobs currently executing in the worker pool")
         self.m_duration = m.histogram(
@@ -258,6 +305,12 @@ class JobScheduler:
             "terminal": self._terminal_count,
             "stopping": self._stop.is_set(),
         }
+
+    def _note_terminal(self, rec: JobRecord) -> None:
+        with self._records_lock:
+            self._terminal_count += 1
+        if self.admission is not None:
+            self.admission.note_terminal(rec.msg_id)
 
     # ---------------------------------------------------------- dispatcher
     def _scan_pending(self, now: float) -> list[tuple[tuple, Path, dict]]:
@@ -302,6 +355,24 @@ class JobScheduler:
         self._drain_handoff()
         self._drained.set()
 
+    def _bump_claims(self, claimed: Path, msg: dict) -> dict:
+        """Persist a per-message claim counter INTO the claimed file.  The
+        handled-failure path persists ``service.attempts``; claims count the
+        attempts that never got to be handled — a job that hard-crashes the
+        process cycles claim → crash → requeue_stale without ever moving its
+        attempt counter, and this is the evidence that breaks the cycle."""
+        svc = dict(msg.get("service", {}))
+        svc["claims"] = int(svc.get("claims", 0)) + 1
+        updated = {**msg, "service": svc}
+        tmp = self.root / "pending" / f".{claimed.name}.tmp"
+        try:
+            tmp.write_text(json.dumps(updated, indent=2))
+            os.replace(tmp, claimed)
+        except OSError:
+            logger.warning("scheduler: could not persist claim count for %s",
+                           claimed.name, exc_info=True)
+        return updated
+
     def _admit_one(self) -> bool:
         """Claim and hand off the single best eligible message, then return
         so the next admission re-scans with FRESH fairness keys (per-tenant
@@ -313,6 +384,13 @@ class JobScheduler:
             if claimed is None:
                 continue              # another scheduler/daemon won the race
             msg_id = claimed.stem
+            if isinstance(msg, dict) and msg:
+                msg = self._bump_claims(claimed, msg)
+                claims = int(msg.get("service", {}).get("claims", 0))
+                if self.cfg.quarantine_after and \
+                        claims > self.cfg.quarantine_after:
+                    self._quarantine(claimed, msg, claims)
+                    return True       # progress made; rescan immediately
             rec = self._record(msg_id)
             rec.ds_id = str(msg.get("ds_id", ""))
             rec.tenant = str(msg.get("tenant", "default"))
@@ -369,6 +447,18 @@ class JobScheduler:
         return int(svc.get("max_attempts", msg.get("max_attempts",
                                                    self.retry.max_attempts)))
 
+    def _deadline_at(self, msg: dict) -> float:
+        """Absolute deadline for a message: ``service.deadline_at`` (set by
+        the API from ``deadline_s`` at submit) wins; a raw ``deadline_s`` is
+        anchored at publish time.  0 = no deadline."""
+        svc = msg.get("service", {}) if isinstance(msg, dict) else {}
+        if svc.get("deadline_at"):
+            return float(svc["deadline_at"])
+        d = float(svc.get("deadline_s", msg.get("deadline_s", 0.0) or 0.0))
+        if d > 0:
+            return float(msg.get("published_at") or time.time()) + d
+        return 0.0
+
     def _worker_loop(self) -> None:
         while True:
             try:
@@ -386,15 +476,22 @@ class JobScheduler:
     def _run_one(self, claimed: Path, msg: dict) -> None:
         msg_id = claimed.stem
         rec = self._record(msg_id)
-        rec.state = "running"
-        rec.started_at = time.time()
-        rec.attempts += 1
-        if self.metrics:
-            self.m_running.inc()
-        hb = ClaimHeartbeat(claimed, interval_s=self.cfg.heartbeat_interval_s)
-        hb.start()
-        timed_out = False
+        hb = None
+        running_metric = False
         try:
+            if rec.cancel_requested:
+                # DELETE raced the dispatcher's claim: honor it before
+                # spending an attempt (or the device) on a dead job
+                self._terminal_cancelled(claimed, msg, rec,
+                                         "cancelled by user before start")
+                return
+            deadline_at = self._deadline_at(msg)
+            rec.deadline_at = deadline_at
+            if deadline_at and time.time() >= deadline_at:
+                # expired while queued: a late answer is a wrong answer
+                self._terminal_deadline(claimed, msg, rec,
+                                        "deadline exceeded before start")
+                return
             if not isinstance(msg, dict) or not msg:
                 # poison message (unparseable JSON): dead-letter immediately,
                 # keeping the raw payload as evidence (daemon contract)
@@ -402,46 +499,195 @@ class JobScheduler:
                 try:
                     raw = claimed.read_text()
                     msg = json.loads(raw)
-                except (OSError, json.JSONDecodeError) as exc:
+                    if not isinstance(msg, dict):
+                        raise ValueError("message must be a JSON object")
+                except (OSError, ValueError, json.JSONDecodeError) as exc:
                     self._dead_letter(claimed, {"raw": raw}, rec,
                                       f"poison message: {exc}", "")
                     return
+            rec.state = "running"
+            rec.started_at = time.time()
+            rec.attempts += 1
+            if self.metrics:
+                self.m_running.inc()
+                running_metric = True
+            hb = ClaimHeartbeat(claimed, interval_s=self.cfg.heartbeat_interval_s)
+            hb.start()
+            token = CancelToken(deadline_at or None)
             ctx = JobContext(msg_id=msg_id, attempt=rec.attempts,
                              device_token=self.device_token,
-                             metrics=self.metrics)
+                             metrics=self.metrics, cancel=token)
             attempt = _Attempt(self.callback, msg, ctx, self._cb_takes_ctx)
+            with self._records_lock:
+                self._live[msg_id] = (token, attempt)
+            timeout_s = self._job_timeout_s(msg)
+            if deadline_at:
+                timeout_s = min(timeout_s, max(0.0, deadline_at - time.time()))
             t0 = time.perf_counter()
             attempt.start()
-            attempt.join(timeout=self._job_timeout_s(msg))
+            attempt.join(timeout=timeout_s)
+            timed_out = attempt.is_alive()
+            abandoned = False
+            if timed_out:
+                # the abandoned-thread fix: deliver a cooperative cancel and
+                # give the attempt a bounded grace to unwind — releasing the
+                # device token and skipping the store — before the spool
+                # moves happen
+                reason = ("deadline exceeded mid-attempt"
+                          if token.deadline_exceeded() else
+                          f"timeout: attempt {rec.attempts} exceeded "
+                          f"{timeout_s:.1f}s")
+                self._deliver_cancel(token, rec, reason)
+                attempt.join(timeout=self.cfg.cancel_grace_s)
+                abandoned = attempt.is_alive()
+                if abandoned and self.metrics:
+                    self.m_abandoned.inc()
             dt = time.perf_counter() - t0
             if self.metrics:
                 self.m_duration.observe(dt)
-            if attempt.is_alive():
-                timed_out = True
-                if self.metrics:
-                    self.m_timeouts.inc()
-                self._handle_failure(
+            if self.admission is not None:
+                self.admission.observe_latency(dt)
+            if not timed_out and attempt.error is None:
+                # clean completion — including one that outran a late cancel:
+                # the work is done and stored, so "done" is the honest state
+                self._finish(claimed, rec)
+                return
+            if timed_out and self.metrics and not token.deadline_exceeded():
+                self.m_timeouts.inc()
+            is_cancel_exc = isinstance(attempt.error, JobCancelledError)
+            if token.deadline_exceeded() or \
+                    isinstance(attempt.error, DeadlineExceededError):
+                err = token.reason or str(attempt.error)
+                self._terminal_deadline(
                     claimed, msg, rec,
-                    f"timeout: attempt {rec.attempts} exceeded "
-                    f"{self._job_timeout_s(msg):.1f}s (abandoned)", "")
-            elif attempt.error is not None:
+                    err + (" (abandoned)" if abandoned else ""))
+            elif rec.cancel_requested == "user":
+                self._terminal_cancelled(
+                    claimed, msg, rec,
+                    (token.reason or "cancelled by user")
+                    + (" (abandoned)" if abandoned else ""))
+            elif timed_out or is_cancel_exc:
+                # timeout / watchdog stall — a normal failure under the
+                # retry policy (the next attempt may behave)
+                err = token.reason or str(attempt.error) or "cancelled"
+                if abandoned:
+                    err += " (abandoned)"
+                self._handle_failure(claimed, msg, rec, err, "")
+            else:
                 self._handle_failure(claimed, msg, rec,
                                      str(attempt.error), attempt.tb)
-            else:
-                self._finish(claimed, rec)
         finally:
-            if timed_out:
-                # the zombie attempt must not keep refreshing the heartbeat
+            with self._records_lock:
+                self._live.pop(msg_id, None)
+            if hb is not None:
                 hb.stop()
-            else:
-                hb.stop()
-            if self.metrics:
+            if running_metric:
                 self.m_running.dec()
             with self._records_lock:
                 t = rec.tenant
                 self._inflight_by_tenant[t] = max(
                     0, self._inflight_by_tenant.get(t, 1) - 1)
 
+    # ------------------------------------------------------- cancellation
+    def _deliver_cancel(self, token: CancelToken, rec: JobRecord,
+                        reason: str) -> None:
+        """The single seam every cancellation (timeout, deadline, user,
+        watchdog) passes through on its way to the attempt's token."""
+        failpoint(FP_CANCEL_DELIVER)
+        if token.cancel(reason) and self.metrics:
+            kind = ("deadline" if reason.startswith("deadline") else
+                    "stalled" if reason.startswith("stalled") else
+                    "user" if "user" in reason else "timeout")
+            if kind != "deadline":   # deadline counts once, at its terminal
+                self.m_cancels.labels(reason=kind).inc()
+        rec.error = reason
+
+    def cancel(self, msg_id: str, reason: str = "cancelled by user") -> str:
+        """``DELETE /jobs/<id>``.  Returns the disposition:
+
+        - ``"cancelling"`` — a cancel was delivered to a live/claimed
+          attempt; the job unwinds at its next cooperative checkpoint;
+        - ``"cancelled"``  — the message was still queued and is now
+          terminally cancelled (moved to ``failed/`` with the reason);
+        - ``"terminal"``   — already done/failed/cancelled/quarantined;
+        - ``"not_found"``  — unknown msg_id.
+        """
+        with self._records_lock:
+            rec = self._records.get(msg_id)
+            live = self._live.get(msg_id)
+        if rec is not None and rec.state in TERMINAL_STATES:
+            return "terminal"
+        if live is not None:
+            token, _attempt = live
+            rec.cancel_requested = "user"
+            self._deliver_cancel(token, rec, reason)
+            return "cancelling"
+        # queued (pending/retry_wait): terminally cancel by atomic rename —
+        # losing the race to the dispatcher's claim degrades to the flag path
+        src = self.root / "pending" / f"{msg_id}.json"
+        dst = self.root / "failed" / f"{msg_id}.json"
+        try:
+            os.replace(src, dst)
+        except FileNotFoundError:
+            with self._records_lock:
+                rec = self._records.get(msg_id)
+                live = self._live.get(msg_id)
+            if live is not None:
+                token, _attempt = live
+                rec.cancel_requested = "user"
+                self._deliver_cancel(token, rec, reason)
+                return "cancelling"
+            if rec is not None and rec.state in ("claimed", "queued",
+                                                 "running", "retry_wait"):
+                # claimed-but-unstarted (hand-off buffer): the worker honors
+                # the flag before starting the attempt
+                rec.cancel_requested = "user"
+                return "cancelling"
+            return "not_found"
+        try:
+            msg = json.loads(dst.read_text())
+            if not isinstance(msg, dict):
+                msg = {}
+        except (OSError, json.JSONDecodeError):
+            msg = {}
+        msg["error"] = reason
+        msg["cancelled"] = True
+        dst.write_text(json.dumps(msg, indent=2))
+        rec = self._record(msg_id)
+        rec.state = "cancelled"
+        rec.error = reason
+        rec.finished_at = time.time()
+        self._note_terminal(rec)
+        if self.metrics:
+            self.m_jobs.labels(state="cancelled").inc()
+            self.m_cancels.labels(reason="user").inc()
+        logger.info("scheduler: %s cancelled while queued", msg_id)
+        return "cancelled"
+
+    def _watchdog_loop(self) -> None:
+        """Cancel attempts whose per-phase progress heartbeat stalled —
+        ``CancelToken.check()`` doubles as the progress touch, so any job
+        that keeps reaching phase/checkpoint boundaries stays alive."""
+        while not self._stop.wait(self.cfg.watchdog_interval_s):
+            now = time.time()
+            with self._records_lock:
+                live = [(mid, tok) for mid, (tok, _a) in self._live.items()]
+            for msg_id, token in live:
+                if token.cancelled():
+                    continue
+                stalled = now - token.last_progress
+                if stalled >= self.cfg.watchdog_stall_s:
+                    rec = self._record(msg_id)
+                    logger.warning(
+                        "scheduler: watchdog cancelling %s — no progress "
+                        "for %.1fs (last phase %r)", msg_id, stalled,
+                        token.progress_phase)
+                    self._deliver_cancel(
+                        token, rec,
+                        f"stalled: no progress for {stalled:.1f}s "
+                        f"(last phase {token.progress_phase or 'unknown'})")
+
+    # ----------------------------------------------------------- outcomes
     def _finish(self, claimed: Path, rec: JobRecord) -> None:
         # same seam as the daemon consumer's: job succeeded, message not yet
         # in done/ — a crash here must reprocess idempotently, never lose it
@@ -450,8 +696,7 @@ class JobScheduler:
         clear_heartbeat(claimed)
         rec.state = "done"
         rec.finished_at = time.time()
-        with self._records_lock:
-            self._terminal_count += 1
+        self._note_terminal(rec)
         if self.metrics:
             self.m_jobs.labels(state="done").inc()
         logger.info("scheduler: %s done (attempt %d)", claimed.name, rec.attempts)
@@ -503,13 +748,73 @@ class JobScheduler:
             pass
         clear_heartbeat(claimed)
         rec.state = "failed"
+        rec.error = error
         rec.finished_at = time.time()
-        with self._records_lock:
-            self._terminal_count += 1
+        self._note_terminal(rec)
         if self.metrics:
             self.m_jobs.labels(state="failed").inc()
         logger.error("scheduler: %s dead-lettered after %d attempt(s): %s",
                      claimed.name, rec.attempts, error)
+
+    def _terminal_cancelled(self, claimed: Path, msg: dict, rec: JobRecord,
+                            error: str) -> None:
+        """User cancel honored: the message is terminal (never retried),
+        filed under failed/ with ``cancelled: true`` for the audit trail."""
+        failed = dict(msg) if isinstance(msg, dict) and msg else {}
+        failed["error"] = error
+        failed["cancelled"] = True
+        failed["attempts"] = rec.attempts
+        (self.root / "failed" / claimed.name).write_text(
+            json.dumps(failed, indent=2))
+        try:
+            claimed.unlink()
+        except FileNotFoundError:
+            pass
+        clear_heartbeat(claimed)
+        rec.state = "cancelled"
+        rec.error = error
+        rec.finished_at = time.time()
+        self._note_terminal(rec)
+        if self.metrics:
+            self.m_jobs.labels(state="cancelled").inc()
+        logger.info("scheduler: %s cancelled (%s)", claimed.name, error)
+
+    def _terminal_deadline(self, claimed: Path, msg: dict, rec: JobRecord,
+                           error: str) -> None:
+        """Deadline exceeded: terminal — retrying a job whose answer is
+        already too late only wastes the device."""
+        if self.metrics:
+            self.m_cancels.labels(reason="deadline").inc()
+        self._dead_letter(claimed, msg if isinstance(msg, dict) else {},
+                          rec, error, "")
+
+    def _quarantine(self, claimed: Path, msg: dict, claims: int) -> None:
+        """A message claimed ``claims`` times without ever reaching a
+        terminal outcome is crash-looping the worker process (a handled
+        failure would have dead-lettered it via max_attempts).  Park it in
+        quarantine/ with the accumulated evidence instead of cycling
+        through requeue forever."""
+        rec = self._record(claimed.stem)
+        rec.ds_id = str(msg.get("ds_id", ""))
+        rec.tenant = str(msg.get("tenant", "default"))
+        reason = (f"quarantined after {claims} claims without a terminal "
+                  f"outcome (quarantine_after="
+                  f"{self.cfg.quarantine_after}); suspected crash-looper")
+        q = dict(msg)
+        q["quarantined_at"] = time.time()
+        q["quarantine_reason"] = reason
+        (self.root / "quarantine" / claimed.name).write_text(
+            json.dumps(q, indent=2))
+        claimed.unlink()
+        clear_heartbeat(claimed)
+        rec.state = "quarantined"
+        rec.error = reason
+        rec.finished_at = time.time()
+        self._note_terminal(rec)
+        if self.metrics:
+            self.m_jobs.labels(state="quarantined").inc()
+            self.m_quarantined.inc()
+        logger.error("scheduler: %s %s", claimed.name, reason)
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -532,6 +837,11 @@ class JobScheduler:
                                  name=f"sched-worker-{i}")
             w.start()
             self._threads.append(w)
+        if self.cfg.watchdog_stall_s > 0:
+            wd = threading.Thread(target=self._watchdog_loop, daemon=True,
+                                  name="sched-watchdog")
+            wd.start()
+            self._threads.append(wd)
         logger.info("scheduler: started (%d workers, queue %s)",
                     self.cfg.workers, self.root)
 

@@ -17,9 +17,12 @@ import time
 from pathlib import Path
 
 from ..engine.daemon import QUEUE_ANNOTATE, QueuePublisher, _STATES
+from ..models.breaker import attach_metrics as attach_breaker_metrics
+from ..models.breaker import get_device_breaker
 from ..utils.config import SMConfig
 from ..utils.failpoints import attach_metrics as attach_failpoint_metrics
 from ..utils.logger import logger, set_phase_observer
+from .admission import AdmissionController
 from .api import AdminAPI
 from .metrics import MetricsRegistry
 from .scheduler import JobScheduler
@@ -41,8 +44,18 @@ class AnnotationService:
         self.queue = queue
         self.metrics = MetricsRegistry()
         self.publisher = QueuePublisher(queue_dir, queue=queue)
+        # overload protection in front of /submit: bounded depth, per-tenant
+        # quotas, EWMA latency shedding (service/admission.py); the
+        # scheduler feeds terminal outcomes + attempt latency back into it
+        self.admission = AdmissionController(cfg.admission, metrics=self.metrics)
+        self.admission.sync_from_spool(self.queue_dir / queue)
         self.scheduler = JobScheduler(
-            queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics)
+            queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
+            admission=self.admission)
+        # device-backend circuit breaker: configure the process singleton
+        # from THIS service's knobs and export its state on /metrics
+        get_device_breaker(cfg)
+        attach_breaker_metrics(self.metrics)
         self.residency = residency
         self.started_at = time.time()
         self._stop_requested = threading.Event()
@@ -90,6 +103,10 @@ class AnnotationService:
     def queue_depths(self) -> dict:
         root = self.queue_dir / self.queue
         return {s: len(list(root.glob(f"{s}/*.json"))) for s in _STATES}
+
+    def stopping(self) -> bool:
+        """True once shutdown began — /submit sheds with 503 from here on."""
+        return self._stop_requested.is_set()
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:

@@ -1,0 +1,123 @@
+"""Cooperative cancellation + deadline propagation (ISSUE 4 tentpole).
+
+The scheduler used to *abandon* a timed-out attempt thread (Python cannot
+kill a thread): the zombie kept running, kept holding the TPU device token,
+and kept writing results while its message was already requeued.  The fix is
+a ``CancelToken`` threaded from the scheduler through ``SearchJob`` and both
+scoring backends, checked at phase and checkpoint-group boundaries::
+
+    token.check("score")      # raises JobCancelledError once cancelled
+
+so a cancelled attempt unwinds cooperatively: the device token is released
+by the normal ``with`` exit, no partial results are stored (the store phase
+is guarded by a check), and the worker requeues or terminates the message
+cleanly instead of leaking a zombie.
+
+Cancellation sources (``token.reason`` records the first winner):
+
+- per-attempt **timeout** (the scheduler's join deadline elapsed);
+- an absolute **deadline** carried by the submit (``deadline_s`` →
+  ``service.deadline_at``): ``check()`` trips itself once the wall clock
+  passes it, with no scheduler involvement;
+- an explicit **user cancel** (``DELETE /jobs/<id>``);
+- the **watchdog** (per-phase progress heartbeat stalled — ``check()``
+  doubles as the progress touch, so a job that keeps reaching boundaries
+  is never considered stalled).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class JobCancelledError(RuntimeError):
+    """Raised inside a job when its CancelToken has been tripped."""
+
+
+class DeadlineExceededError(JobCancelledError):
+    """The job's absolute deadline passed (terminal — never retried)."""
+
+
+class CancelToken:
+    """Thread-safe one-shot cancellation flag with an optional absolute
+    deadline and a progress heartbeat for the scheduler's stall watchdog."""
+
+    def __init__(self, deadline_at: float | None = None):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.reason = ""
+        self.deadline_at = deadline_at or None
+        self.last_progress = time.time()
+        self.progress_phase = ""
+
+    def cancel(self, reason: str) -> bool:
+        """Trip the token.  The first cancel wins (its reason sticks);
+        returns True when THIS call did the tripping."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.reason = reason
+            self._event.set()
+            return True
+
+    def cancelled(self) -> bool:
+        """True once cancelled — including by a passed deadline, which is
+        detected lazily here so pure pollers see it without a watcher."""
+        if self._event.is_set():
+            return True
+        if self.deadline_at is not None and time.time() >= self.deadline_at:
+            self.cancel(f"deadline exceeded ({self.deadline_at:.3f})")
+            return True
+        return False
+
+    def deadline_exceeded(self) -> bool:
+        return self.deadline_at is not None and time.time() >= self.deadline_at
+
+    def remaining_s(self) -> float | None:
+        """Seconds until the deadline (None when no deadline is set)."""
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.time()
+
+    def touch(self, phase: str = "") -> None:
+        """Progress heartbeat: the watchdog cancels attempts whose last
+        touch is older than ``service.watchdog_stall_s``."""
+        self.last_progress = time.time()
+        if phase:
+            self.progress_phase = phase
+
+    def check(self, phase: str = "") -> None:
+        """The cooperative checkpoint: record progress, then raise if the
+        token is tripped (``DeadlineExceededError`` for deadline trips so
+        the scheduler can tell terminal from retryable)."""
+        self.touch(phase)
+        if self.cancelled():
+            if self.reason.startswith("deadline"):
+                raise DeadlineExceededError(self.reason)
+            raise JobCancelledError(self.reason or "cancelled")
+
+
+@contextlib.contextmanager
+def hold_cancellable(lock, cancel: CancelToken | None, poll_s: float = 0.1,
+                     phase: str = "device_token"):
+    """``with lock:`` that stays cancellable while WAITING for the lock —
+    a cancelled job must not sit in the device-token queue forever.  With no
+    lock or no token this degrades to the plain context manager forms."""
+    if lock is None:
+        if cancel is not None:
+            cancel.check(phase)
+        yield
+        return
+    if cancel is None:
+        with lock:
+            yield
+        return
+    while not lock.acquire(timeout=poll_s):
+        cancel.check(f"{phase}_wait")
+    try:
+        cancel.check(phase)
+        yield
+    finally:
+        lock.release()

@@ -175,6 +175,37 @@ class ParallelConfig:
 
 
 @dataclass(frozen=True)
+class AdmissionConfig:
+    """Overload protection for ``POST /submit`` (docs/SERVICE.md "Overload &
+    degradation model").  A shed submit gets a structured 429/503 with a
+    ``Retry-After`` header instead of joining an unbounded backlog."""
+    max_queue_depth: int = 512           # admitted-but-not-terminal bound
+                                         # across all tenants; 0 = unlimited
+    max_tenant_inflight: int = 128       # per-tenant admitted-but-not-
+                                         # terminal bound; 0 = unlimited
+    ewma_alpha: float = 0.2              # weight of the newest job latency
+    latency_shed_s: float = 0.0          # EWMA job latency that starts
+                                         # shedding (503); 0 disables
+    latency_resume_s: float = 0.0        # hysteresis floor: resume accepting
+                                         # below this (0 = 0.75 * shed)
+    retry_after_s: float = 1.0           # Retry-After hint on shed responses
+
+    def __post_init__(self):
+        if self.max_queue_depth < 0 or self.max_tenant_inflight < 0:
+            raise ValueError("admission: depth/quota bounds must be >= 0")
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError("admission: ewma_alpha must be in (0, 1]")
+        if self.latency_shed_s < 0 or self.latency_resume_s < 0:
+            raise ValueError("admission: latency thresholds must be >= 0")
+        if self.retry_after_s < 0:
+            raise ValueError("admission: retry_after_s must be >= 0")
+
+    @property
+    def effective_resume_s(self) -> float:
+        return self.latency_resume_s or 0.75 * self.latency_shed_s
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """Annotation-service knobs (scheduler + failure policy + admin API) —
     the serving-side analog of the reference's rabbitmq/daemon settings.
@@ -195,12 +226,38 @@ class ServiceConfig:
     drain_timeout_s: float = 30.0        # graceful-shutdown wait for running
     http_host: str = "127.0.0.1"         # admin API bind (healthz/metrics/
     http_port: int = 8685                # jobs/submit); port 0 = ephemeral
+    # --- cooperative cancellation (utils/cancel.py, docs/SERVICE.md) ---
+    cancel_grace_s: float = 15.0         # after a cancel is delivered, how
+                                         # long the worker waits for the
+                                         # attempt thread to unwind before
+                                         # declaring it abandoned
+    watchdog_interval_s: float = 5.0     # stall-watchdog scan cadence
+    watchdog_stall_s: float = 0.0        # cancel attempts whose progress
+                                         # heartbeat is older than this;
+                                         # 0 disables the watchdog
+    # --- poison-job quarantine ---
+    quarantine_after: int = 8            # claims without a terminal outcome
+                                         # before a message moves to
+                                         # quarantine/; 0 disables
+    # --- device-backend circuit breaker (models/breaker.py) ---
+    breaker_threshold: int = 3           # consecutive device errors → open
+    breaker_cooldown_s: float = 30.0     # open → half-open probe delay
+    breaker_degraded_batch: int = 512    # numpy-fallback formula batch while
+                                         # the breaker is open (reduced from
+                                         # parallel.formula_batch)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
 
     def __post_init__(self):
         if self.workers <= 0 or self.max_attempts <= 0:
             raise ValueError("service: workers/max_attempts must be positive")
         if self.backoff_base_s < 0 or self.backoff_max_s < 0 or self.backoff_jitter < 0:
             raise ValueError("service: backoff knobs must be non-negative")
+        if self.cancel_grace_s < 0 or self.watchdog_interval_s <= 0 or \
+                self.watchdog_stall_s < 0 or self.quarantine_after < 0:
+            raise ValueError("service: cancel/watchdog/quarantine knobs out of range")
+        if self.breaker_threshold <= 0 or self.breaker_cooldown_s < 0 or \
+                self.breaker_degraded_batch <= 0:
+            raise ValueError("service: breaker knobs out of range")
 
 
 @dataclass(frozen=True)
@@ -274,4 +331,5 @@ _DATACLASS_FIELDS = {
     ("SMConfig", "parallel"): ParallelConfig,
     ("SMConfig", "storage"): StorageConfig,
     ("SMConfig", "service"): ServiceConfig,
+    ("ServiceConfig", "admission"): AdmissionConfig,
 }

@@ -48,3 +48,15 @@ def _reset_config_singleton():
     SMConfig._instance = None
     yield
     SMConfig._instance = None
+
+
+@pytest.fixture(autouse=True)
+def _reset_device_breaker():
+    """Isolate the device circuit breaker process-global between tests: a
+    test whose jax path raises must not open the breaker and silently
+    degrade every LATER jax test to numpy scoring."""
+    from sm_distributed_tpu.models import breaker
+
+    breaker.reset_device_breaker()
+    yield
+    breaker.reset_device_breaker()

@@ -1,0 +1,24 @@
+"""The load-sweep smoke (scripts/load_sweep.py --smoke) from pytest, so the
+ISSUE 4 serving invariants — bounded burst depth, structured sheds, terminal
+deadlines, dead-letter + quarantine poison handling, zero thread/token/file
+debris — are enforced by tier-1, not only by the opt-in CI stage."""
+
+import pytest
+
+from sm_distributed_tpu.models import breaker as breaker_mod
+from sm_distributed_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    breaker_mod.reset_device_breaker()
+    failpoints.reset()
+    yield
+    breaker_mod.reset_device_breaker()
+    failpoints.reset()
+
+
+def test_load_sweep_smoke(tmp_path):
+    from scripts.load_sweep import run_sweep
+
+    assert run_sweep(tmp_path / "sweep", smoke=True) == 0

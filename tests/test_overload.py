@@ -1,0 +1,542 @@
+"""Overload-protection, deadline, cancellation, and degradation tests
+(ISSUE 4): CancelToken semantics, the abandoned-thread fix (cooperative
+timeout cancel + device-token release + no double-claim), deadline
+propagation, the stall watchdog, poison-job quarantine, admission control
+(depth bound, tenant quotas, EWMA hysteresis, 429/503 body shape), and the
+device circuit breaker (unit + through a real search)."""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sm_distributed_tpu.engine.daemon import QueuePublisher
+from sm_distributed_tpu.models import breaker as breaker_mod
+from sm_distributed_tpu.models.breaker import CircuitBreaker
+from sm_distributed_tpu.service import AnnotationService, JobScheduler
+from sm_distributed_tpu.service.admission import AdmissionController
+from sm_distributed_tpu.utils import failpoints
+from sm_distributed_tpu.utils.cancel import (
+    CancelToken,
+    DeadlineExceededError,
+    JobCancelledError,
+    hold_cancellable,
+)
+from sm_distributed_tpu.utils.config import (
+    AdmissionConfig,
+    ServiceConfig,
+    SMConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Breaker singleton + failpoint activation are process-global."""
+    breaker_mod.reset_device_breaker()
+    failpoints.reset()
+    yield
+    breaker_mod.reset_device_breaker()
+    failpoints.reset()
+
+
+def _fast_cfg(**kw) -> ServiceConfig:
+    base = dict(workers=2, poll_interval_s=0.02, job_timeout_s=5.0,
+                max_attempts=3, backoff_base_s=0.05, backoff_max_s=0.5,
+                backoff_jitter=0.0, heartbeat_interval_s=0.05,
+                stale_after_s=0.5, drain_timeout_s=10.0, cancel_grace_s=5.0,
+                http_port=0)
+    base.update(kw)
+    return ServiceConfig(**base)
+
+
+def _sm(tmp_path, **service_kw) -> SMConfig:
+    return dataclasses.replace(
+        SMConfig.from_dict({"work_dir": str(tmp_path / "work")}),
+        service=_fast_cfg(**service_kw))
+
+
+# ----------------------------------------------------------- CancelToken
+def test_cancel_token_basics():
+    t = CancelToken()
+    assert not t.cancelled()
+    t.check("phase1")                      # no-op while un-cancelled
+    assert t.progress_phase == "phase1"
+    assert t.cancel("stop it")
+    assert not t.cancel("second caller")   # first reason sticks
+    assert t.reason == "stop it"
+    with pytest.raises(JobCancelledError, match="stop it"):
+        t.check()
+
+
+def test_cancel_token_deadline_self_trips():
+    t = CancelToken(deadline_at=time.time() - 0.01)
+    assert t.deadline_exceeded()
+    assert t.cancelled()                   # lazy self-trip
+    with pytest.raises(DeadlineExceededError):
+        t.check()
+    t2 = CancelToken(deadline_at=time.time() + 60.0)
+    assert not t2.cancelled()
+    assert 59.0 < t2.remaining_s() <= 60.0
+
+
+def test_hold_cancellable_releases_on_cancel():
+    lock = threading.Lock()
+    t = CancelToken()
+    with hold_cancellable(lock, t):
+        assert lock.locked()
+    assert not lock.locked()
+    # cancelled while WAITING for a held lock -> raises, never acquires
+    other = threading.Lock()
+    other.acquire()
+    t.cancel("no more waiting")
+    with pytest.raises(JobCancelledError):
+        with hold_cancellable(other, t, poll_s=0.01):
+            pass
+    other.release()
+
+
+# ---------------------------------------- the abandoned-thread fix (tentpole)
+def test_timeout_cancels_cooperatively_and_releases_device_token(tmp_path):
+    """A timed-out attempt used to be abandoned while holding the TPU token
+    and kept running forever.  Now the cancel token stops it at the next
+    cooperative checkpoint, the token is released, and the message follows
+    the normal retry policy."""
+    entered = threading.Event()
+
+    def cb(msg, ctx):
+        with ctx.device_token:
+            entered.set()
+            while True:
+                ctx.cancel.check("spin")     # cooperative boundary
+                time.sleep(0.005)
+
+    sched = JobScheduler(tmp_path / "q", cb,
+                         config=_fast_cfg(workers=1, max_attempts=1,
+                                          job_timeout_s=0.2))
+    QueuePublisher(tmp_path / "q").publish(
+        {"ds_id": "slow", "input_path": "/in", "msg_id": "slow"})
+    sched.start()
+    assert sched.wait_for_terminal(1, timeout_s=15.0), sched.stats()
+    assert sched.shutdown()
+    # the attempt thread exited (no zombies) and released the device token
+    zombies = [t for t in threading.enumerate()
+               if t.name.startswith("attempt-") and t.is_alive()]
+    assert not zombies, zombies
+    assert sched.device_token.acquire(timeout=1.0)
+    sched.device_token.release()
+    root = tmp_path / "q" / "sm_annotate"
+    dl = json.loads((root / "failed" / "slow.json").read_text())
+    assert "timeout" in dl["error"] and "(abandoned)" not in dl["error"]
+    assert entered.is_set()
+
+
+def test_timed_out_attempt_not_double_claimed(tmp_path):
+    """After a timeout-retry republish the message exists EXACTLY once in
+    pending/, running/ is clean, and requeue_stale() finds nothing to
+    recover — the zombie's claim is fully released, not leaked."""
+    attempts = []
+
+    def cb(msg, ctx):
+        attempts.append(time.time())
+        if len(attempts) == 1:
+            while True:
+                ctx.cancel.check("spin")
+                time.sleep(0.005)
+
+    cfg = _fast_cfg(workers=1, max_attempts=3, job_timeout_s=0.2,
+                    backoff_base_s=0.4)
+    sched = JobScheduler(tmp_path / "q", cb, config=cfg)
+    QueuePublisher(tmp_path / "q").publish(
+        {"ds_id": "j", "input_path": "/in", "msg_id": "j"})
+    sched.start()
+    root = tmp_path / "q" / "sm_annotate"
+    # wait for the first attempt to time out and republish into pending/
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if list(root.glob("pending/j.json")) and len(attempts) == 1:
+            break
+        time.sleep(0.01)
+    pending = list(root.glob("pending/*.json"))
+    running = list(root.glob("running/*.json"))
+    assert [p.name for p in pending] == ["j.json"], pending
+    assert running == [], "timed-out claim still in running/"
+    assert sched.requeue_stale() == 0, "requeue_stale double-claimed"
+    msg = json.loads((root / "pending" / "j.json").read_text())
+    assert msg["service"]["attempts"] == 1 and "timeout" in msg["service"]["last_error"]
+    # the retry then succeeds
+    assert sched.wait_for_terminal(1, timeout_s=15.0)
+    assert sched.shutdown()
+    assert {p.stem for p in root.glob("done/*.json")} == {"j"}
+    assert len(attempts) == 2
+
+
+def test_deadline_exceeded_is_terminal_not_retried(tmp_path):
+    def cb(msg, ctx):
+        while True:
+            ctx.cancel.check("spin")
+            time.sleep(0.005)
+
+    sched = JobScheduler(tmp_path / "q", cb,
+                         config=_fast_cfg(workers=1, max_attempts=3))
+    pub = QueuePublisher(tmp_path / "q")
+    pub.publish({"ds_id": "dl", "input_path": "/in", "msg_id": "dl",
+                 "deadline_s": 0.2})
+    sched.start()
+    assert sched.wait_for_terminal(1, timeout_s=15.0)
+    assert sched.shutdown()
+    root = tmp_path / "q" / "sm_annotate"
+    dl = json.loads((root / "failed" / "dl.json").read_text())
+    assert "deadline" in dl["error"]
+    assert dl["attempts"] == 1, "deadline-expired job was retried"
+
+
+def test_expired_deadline_sheds_before_start(tmp_path):
+    ran = []
+    sched = JobScheduler(tmp_path / "q", lambda msg, ctx: ran.append(1),
+                         config=_fast_cfg(workers=1))
+    pub = QueuePublisher(tmp_path / "q")
+    pub.publish({"ds_id": "late", "input_path": "/in", "msg_id": "late",
+                 "service": {"deadline_at": time.time() - 1.0}})
+    sched.start()
+    assert sched.wait_for_terminal(1, timeout_s=10.0)
+    assert sched.shutdown()
+    assert ran == [], "expired job still ran"
+    root = tmp_path / "q" / "sm_annotate"
+    dl = json.loads((root / "failed" / "late.json").read_text())
+    assert "deadline exceeded before start" in dl["error"]
+
+
+def test_watchdog_cancels_stalled_attempt(tmp_path):
+    """An attempt whose progress heartbeat stops moving is cancelled by the
+    watchdog (reason 'stalled') and follows the retry policy."""
+    def cb(msg, ctx):
+        # never touches the token -> last_progress stays at attempt start
+        while True:
+            time.sleep(0.005)
+            if ctx.cancel.cancelled():      # polling does not count as progress
+                ctx.cancel.check()
+
+    sched = JobScheduler(
+        tmp_path / "q", cb,
+        config=_fast_cfg(workers=1, max_attempts=1, watchdog_stall_s=0.2,
+                         watchdog_interval_s=0.05))
+    QueuePublisher(tmp_path / "q").publish(
+        {"ds_id": "stall", "input_path": "/in", "msg_id": "stall"})
+    sched.start()
+    assert sched.wait_for_terminal(1, timeout_s=15.0)
+    assert sched.shutdown()
+    root = tmp_path / "q" / "sm_annotate"
+    dl = json.loads((root / "failed" / "stall.json").read_text())
+    assert "stalled" in dl["error"], dl["error"]
+
+
+def test_crash_looping_message_quarantined(tmp_path):
+    """A message whose persisted claim counter says it keeps getting
+    claimed without a terminal outcome moves to quarantine/ instead of
+    cycling forever; the callback never even runs."""
+    ran = []
+    sched = JobScheduler(tmp_path / "q", lambda msg, ctx: ran.append(1),
+                         config=_fast_cfg(workers=1, quarantine_after=2))
+    QueuePublisher(tmp_path / "q").publish(
+        {"ds_id": "loop", "input_path": "/in", "msg_id": "loop",
+         "service": {"claims": 2, "last_error": "boom (previous crash)"}})
+    sched.start()
+    assert sched.wait_for_terminal(1, timeout_s=10.0)
+    assert sched.shutdown()
+    assert ran == []
+    root = tmp_path / "q" / "sm_annotate"
+    q = json.loads((root / "quarantine" / "loop.json").read_text())
+    assert q["service"]["claims"] == 3
+    assert "crash-loop" in q["quarantine_reason"]
+    assert q["service"]["last_error"] == "boom (previous crash)"
+    states = {j["msg_id"]: j["state"] for j in sched.jobs()}
+    assert states["loop"] == "quarantined"
+
+
+def test_claims_counter_persists_across_claims(tmp_path):
+    """Every claim bumps service.claims in the message file — the evidence
+    trail the quarantine decision reads (timeout retries count too)."""
+    def cb(msg, ctx):
+        raise RuntimeError("always fails")
+
+    sched = JobScheduler(tmp_path / "q", cb,
+                         config=_fast_cfg(workers=1, max_attempts=2))
+    QueuePublisher(tmp_path / "q").publish(
+        {"ds_id": "c", "input_path": "/in", "msg_id": "c"})
+    sched.start()
+    assert sched.wait_for_terminal(1, timeout_s=10.0)
+    assert sched.shutdown()
+    root = tmp_path / "q" / "sm_annotate"
+    dl = json.loads((root / "failed" / "c.json").read_text())
+    assert dl["service"]["claims"] == 2 and dl["attempts"] == 2
+
+
+# ------------------------------------------------------- admission control
+def _adm(**kw) -> AdmissionController:
+    return AdmissionController(AdmissionConfig(**kw))
+
+
+def test_admission_depth_bound():
+    a = _adm(max_queue_depth=2, max_tenant_inflight=0)
+    assert a.try_admit("t").accepted
+    assert a.try_admit("t").accepted
+    d = a.try_admit("t")
+    assert not d.accepted and d.status == 429 and d.reason == "queue_full"
+    assert d.body()["retry_after_s"] > 0
+    a.confirm("m1", "t")
+    a.note_terminal("m1")
+    assert a.try_admit("t").accepted      # slot freed
+
+
+def test_admission_tenant_quota_fairness():
+    a = _adm(max_queue_depth=10, max_tenant_inflight=2)
+    assert a.try_admit("burst").accepted
+    assert a.try_admit("burst").accepted
+    d = a.try_admit("burst")
+    assert not d.accepted and d.reason == "tenant_quota"
+    # the quiet tenant is unaffected by the burst tenant's quota
+    assert a.try_admit("quiet").accepted
+
+
+def test_admission_ewma_shed_hysteresis():
+    a = _adm(max_queue_depth=0, max_tenant_inflight=0,
+             ewma_alpha=1.0, latency_shed_s=1.0, latency_resume_s=0.5)
+    assert a.try_admit("t").accepted
+    a.observe_latency(2.0)                 # EWMA 2.0 >= 1.0 -> shed
+    d = a.try_admit("t")
+    assert not d.accepted and d.status == 503 and d.reason == "latency_overload"
+    a.observe_latency(0.8)                 # above the resume floor: still shed
+    assert not a.try_admit("t").accepted
+    a.observe_latency(0.4)                 # below 0.5 -> released
+    assert a.try_admit("t").accepted
+
+
+def test_admission_unknown_terminal_is_noop():
+    a = _adm(max_queue_depth=2)
+    a.note_terminal("never_admitted")      # direct-spool publishes
+    assert a.stats()["depth"] == 0
+
+
+# ------------------------------------------------ HTTP: sheds, validation,
+# ------------------------------------------------ DELETE /jobs/<id>
+def _post(base, path, data: bytes):
+    req = urllib.request.Request(base + path, method="POST", data=data,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _delete(base, path):
+    req = urllib.request.Request(base + path, method="DELETE")
+    try:
+        with urllib.request.urlopen(req, timeout=10.0) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _service(tmp_path, cb, **service_kw):
+    svc = AnnotationService(tmp_path / "q", cb,
+                            sm_config=_sm(tmp_path, **service_kw))
+    svc.start()
+    host, port = svc.api.address
+    return svc, f"http://{host}:{port}"
+
+
+def test_submit_sheds_429_with_retry_after(tmp_path):
+    release = threading.Event()
+
+    def cb(msg, ctx):
+        release.wait(20.0)
+
+    svc, base = _service(
+        tmp_path, cb, workers=1,
+        admission=AdmissionConfig(max_queue_depth=1, max_tenant_inflight=1,
+                                  retry_after_s=2.5))
+    try:
+        s1, _h, b1 = _post(base, "/submit", json.dumps(
+            {"ds_id": "a", "input_path": "/in"}).encode())
+        assert s1 == 202 and "msg_id" in b1
+        s2, h2, b2 = _post(base, "/submit", json.dumps(
+            {"ds_id": "b", "input_path": "/in"}).encode())
+        assert s2 == 429
+        assert h2.get("Retry-After") == "2"  # rounded retry_after_s
+        assert b2["reason"] in ("queue_full", "tenant_quota")
+        assert b2["retry_after_s"] == 2.5 and "error" in b2
+        # shed/accept counters exported
+        text = svc.metrics.expose()
+        assert 'sm_admission_total{decision="accepted",reason="accepted"} 1' in text
+        assert 'decision="shed"' in text
+        release.set()
+        assert svc.scheduler.wait_for_terminal(1, timeout_s=15.0)
+    finally:
+        release.set()
+        svc.shutdown()
+
+
+def test_submit_validation_structured_400(tmp_path):
+    svc, base = _service(tmp_path, lambda m, c: None)
+    try:
+        cases = [
+            (b"{not json", "invalid_json"),
+            (b"[1, 2]", "invalid_message"),
+            (json.dumps({"ds_id": "x"}).encode(), "invalid_message"),
+            (json.dumps({"ds_id": "x", "input_path": "/in",
+                         "deadline_s": "soon"}).encode(), "invalid_message"),
+            (json.dumps({"ds_id": "x", "input_path": "/in",
+                         "service": {"timeout_s": -1}}).encode(),
+             "invalid_message"),
+            (json.dumps({"ds_id": "x", "input_path": "/in",
+                         "service": "fast"}).encode(), "invalid_message"),
+        ]
+        for raw, want_reason in cases:
+            status, _h, body = _post(base, "/submit", raw)
+            assert status == 400, (raw, status, body)
+            assert body["reason"] == want_reason and body["error"], (raw, body)
+        # a valid message still goes through
+        status, _h, body = _post(base, "/submit", json.dumps(
+            {"ds_id": "ok", "input_path": "/in", "deadline_s": 30,
+             "priority": "high", "service": {"timeout_s": 5}}).encode())
+        assert status == 202
+        assert svc.scheduler.wait_for_terminal(1, timeout_s=10.0)
+    finally:
+        svc.shutdown()
+
+
+def test_delete_cancels_running_and_queued_jobs(tmp_path):
+    started = threading.Event()
+
+    def cb(msg, ctx):
+        started.set()
+        while True:
+            ctx.cancel.check("spin")
+            time.sleep(0.005)
+
+    svc, base = _service(tmp_path, cb, workers=1)
+    try:
+        s, _h, b = _post(base, "/submit", json.dumps(
+            {"ds_id": "r", "input_path": "/in", "msg_id": "r"}).encode())
+        assert s == 202
+        assert started.wait(10.0)
+        # a second job sits queued behind the single worker
+        s, _h, _b = _post(base, "/submit", json.dumps(
+            {"ds_id": "q2", "input_path": "/in", "msg_id": "q2"}).encode())
+        assert s == 202
+        # queued job: immediate terminal cancel
+        time.sleep(0.1)
+        status, body = _delete(base, "/jobs/q2")
+        assert status in (200, 202), body
+        # running job: cooperative cancel
+        status, body = _delete(base, "/jobs/r")
+        assert status == 202 and body["state"] == "cancelling"
+        assert svc.scheduler.wait_for_terminal(2, timeout_s=15.0)
+        states = {j["msg_id"]: j["state"] for j in svc.scheduler.jobs()}
+        assert states["r"] == "cancelled" and states["q2"] == "cancelled"
+        root = tmp_path / "q" / "sm_annotate"
+        for mid in ("r", "q2"):
+            f = json.loads((root / "failed" / f"{mid}.json").read_text())
+            assert f["cancelled"] is True
+        # terminal re-delete -> 409; unknown -> 404
+        status, _b = _delete(base, "/jobs/r")
+        assert status == 409
+        status, _b = _delete(base, "/jobs/nope")
+        assert status == 404
+        text = svc.metrics.expose()
+        assert 'sm_jobs_total{state="cancelled"} 2' in text
+        assert 'sm_jobs_cancelled_total{reason="user"}' in text
+    finally:
+        svc.shutdown()
+
+
+# --------------------------------------------------------- circuit breaker
+def test_breaker_state_machine():
+    b = CircuitBreaker(threshold=2, cooldown_s=0.05)
+    assert b.state == "closed" and b.allow_device()
+    assert not b.record_failure()          # 1 of 2
+    b.record_success()                     # resets the consecutive count
+    assert not b.record_failure()
+    assert b.record_failure()              # 2 consecutive -> open
+    assert b.state == "open" and not b.allow_device()
+    time.sleep(0.06)
+    assert b.allow_device()                # cooldown elapsed -> half-open probe
+    assert b.state == "half_open"
+    assert b.record_failure()              # probe failed -> open again
+    assert b.state == "open"
+    time.sleep(0.06)
+    assert b.allow_device()
+    b.record_success()                     # probe succeeded -> closed
+    assert b.state == "closed"
+    hops = [(f, t) for _ts, f, t in b.transitions]
+    assert hops == [("closed", "open"), ("open", "half_open"),
+                    ("half_open", "open"), ("open", "half_open"),
+                    ("half_open", "closed")]
+
+
+def test_breaker_opens_and_degrades_real_search(tmp_path):
+    """With backend=jax_tpu and an injected device error, the breaker opens
+    and the SAME search completes on the numpy fallback — results identical
+    to a plain numpy run; the next search degrades from the start."""
+    from sm_distributed_tpu.io.dataset import SpectralDataset
+    from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+    from sm_distributed_tpu.models.msm_basic import MSMBasicSearch
+    from sm_distributed_tpu.utils.config import DSConfig
+
+    path, truth = generate_synthetic_dataset(
+        tmp_path / "ds", nrows=8, ncols=8, formulas=None,
+        present_fraction=0.5, noise_peaks=30, seed=11)
+    ds = SpectralDataset.from_imzml(path)
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]}})
+    common = {"fdr": {"decoy_sample_size": 2, "seed": 1},
+              "parallel": {"formula_batch": 8, "overlap_isocalc": "off"},
+              "service": {"breaker_threshold": 1, "breaker_cooldown_s": 60.0},
+              "work_dir": str(tmp_path / "work")}
+    oracle = MSMBasicSearch(
+        ds, truth.formulas[:4], ds_config,
+        SMConfig.from_dict({"backend": "numpy_ref", **common})).search()
+
+    failpoints.configure("backend.device_error=raise:RuntimeError@1")
+    sm_dev = SMConfig.from_dict({"backend": "jax_tpu", **common})
+    degraded = MSMBasicSearch(ds, truth.formulas[:4], ds_config, sm_dev).search()
+    brk = breaker_mod.get_device_breaker()
+    assert brk.state == "open"
+    import pandas as pd
+
+    pd.testing.assert_frame_equal(degraded.annotations, oracle.annotations)
+    # a second search while open never touches the device (the @1 failpoint
+    # is spent, so a device attempt would succeed and close the breaker)
+    again = MSMBasicSearch(ds, truth.formulas[:4], ds_config, sm_dev).search()
+    assert brk.state == "open"
+    pd.testing.assert_frame_equal(again.annotations, oracle.annotations)
+
+
+def test_breaker_below_threshold_fails_attempt(tmp_path):
+    """Below the threshold a device error is a normal failure — the attempt
+    raises (so the retry policy can probe a healthy device again) and the
+    breaker stays closed."""
+    from sm_distributed_tpu.io.dataset import SpectralDataset
+    from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+    from sm_distributed_tpu.models.msm_basic import MSMBasicSearch
+    from sm_distributed_tpu.utils.config import DSConfig
+
+    path, truth = generate_synthetic_dataset(
+        tmp_path / "ds", nrows=8, ncols=8, formulas=None,
+        present_fraction=0.5, noise_peaks=30, seed=11)
+    ds = SpectralDataset.from_imzml(path)
+    ds_config = DSConfig.from_dict({"isotope_generation": {"adducts": ["+H"]}})
+    sm = SMConfig.from_dict(
+        {"backend": "jax_tpu", "fdr": {"decoy_sample_size": 2, "seed": 1},
+         "parallel": {"overlap_isocalc": "off"},
+         "service": {"breaker_threshold": 3},
+         "work_dir": str(tmp_path / "work")})
+    failpoints.configure("backend.device_error=raise:RuntimeError@1")
+    with pytest.raises(RuntimeError, match="backend.device_error"):
+        MSMBasicSearch(ds, truth.formulas[:4], ds_config, sm).search()
+    assert breaker_mod.get_device_breaker().state == "closed"

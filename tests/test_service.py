@@ -340,7 +340,8 @@ def test_admin_api_endpoints(tmp_path):
         health = json.loads(body)
         assert status == 200 and health["status"] == "ok"
         assert health["queue"] == {"pending": 0, "running": 0,
-                                   "done": 0, "failed": 0}
+                                   "done": 0, "failed": 0, "quarantine": 0}
+        assert health["admission"]["depth"] == 0
 
         # POST /submit → spooled + eventually done
         req = urllib.request.Request(
