@@ -333,9 +333,22 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
                     getattr(backend, "last_warmup_skipped", False)))
 
 
-def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None) -> dict:
+def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
+           cfg: BenchConfig | None = None) -> dict:
     iso = iso or {}
+    # per-phase wall clock (ISSUE 5 satellite): BENCH_*.json trajectories
+    # explain WHERE time moved, not just totals.  stream_s is the median
+    # full-stream wall; floor_rep_s one full floor-sample numpy rep.
+    phases = {
+        "isocalc_s": round(prep["isocalc_dt"], 3),
+        "floor_rep_s": round(floor["floor_n_ions"] / floor["np_rate"], 3),
+        "compile_s": round(jaxr["compile_dt"], 3),
+    }
+    if cfg is not None:
+        phases["stream_s"] = round(
+            cfg.reps * prep["table"].n_ions / jaxr["jax_rate"], 3)
     return {
+        "phases": phases,
         "value": round(jaxr["jax_rate"], 2),
         "jax_spread": round(jaxr["jax_spread"], 4),
         "vs_baseline": round(jaxr["jax_rate"] / floor["np_rate"], 2),
@@ -361,6 +374,39 @@ def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None) -> dict
         "isocalc_workers": iso.get("isocalc_workers"),
         "patterns_per_s": iso.get("patterns_per_s"),
     }
+
+
+def write_bench_trace(cache_dir: Path, configs: list, out: dict) -> str:
+    """Emit the run's per-case phase spans as a trace file (ISSUE 5
+    satellite): the bench JSON pins its path, and trace_report.py renders
+    it like any job trace.  Spans are RETROACTIVE — durations are the
+    measured ones, laid out sequentially (emitting live spans inside the
+    timed hot loops would be measuring the measurement) — flagged with
+    ``retro`` in attrs."""
+    from sm_distributed_tpu.utils import tracing
+
+    trace = tracing.new_trace(job_id="bench",
+                              trace_dir=cache_dir / "traces")
+    t = time.time()
+    t0 = t
+    for cfg in configs:
+        case = out if cfg.name == "headline" else out.get(cfg.name, {})
+        phases = case.get("phases") or {}
+        case_ctx = trace.child()
+        case_t0 = t
+        for phase, dur in phases.items():
+            if not isinstance(dur, (int, float)):
+                continue
+            tracing.emit_span(trace, phase.removesuffix("_s"), ts=t,
+                              dur=float(dur), parent_id=case_ctx.span_id,
+                              retro=True, phase=True)
+            t += float(dur)
+        tracing.emit_span(trace, f"case:{cfg.name}", ts=case_t0,
+                          dur=t - case_t0, span_id=case_ctx.span_id,
+                          parent_id=trace.span_id, retro=True)
+    tracing.emit_span(trace, "bench", ts=t0, dur=t - t0,
+                      span_id=trace.span_id, retro=True)
+    return trace.file
 
 
 def main() -> None:
@@ -437,10 +483,11 @@ def main() -> None:
     out = {
         "metric": "ions_scored_per_sec_per_chip",
         "unit": "ions/s",
-        **report(preps[0], floors[0], jaxrs[0], iso_cold),
+        **report(preps[0], floors[0], jaxrs[0], iso_cold, configs[0]),
     }
     for cfg, p, f, j in zip(configs[1:], preps[1:], floors[1:], jaxrs[1:]):
-        out[cfg.name] = report(p, f, j)
+        out[cfg.name] = report(p, f, j, cfg=cfg)
+    out["trace_path"] = write_bench_trace(cache_dir, configs, out)
     print(json.dumps(out))
 
 

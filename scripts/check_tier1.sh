@@ -15,7 +15,10 @@
 # unique (duplicate registration raises at import), documented in
 # docs/RECOVERY.md, and covered by a chaos scenario.  Then the isocalc
 # parallel smoke gate (scripts/isocalc_smoke.py): a 2-worker spheroid run
-# must produce byte-identical cache shards vs the serial run.
+# must produce byte-identical cache shards vs the serial run.  Then the
+# trace smoke gate (scripts/trace_smoke.py): a traced spheroid job through
+# the real service must emit a schema-valid, Perfetto-loadable trace that
+# trace_report.py renders.
 #
 # Exit codes: 0 = all gates pass, 1 = regression / gate failure.
 # Note: pytest's own exit code is nonzero while the 32 pre-existing
@@ -49,6 +52,14 @@ fi
 # fixture must merge to byte-identical cache shards vs the serial run
 if ! env JAX_PLATFORMS=cpu python scripts/isocalc_smoke.py; then
     echo "check_tier1: FAIL — isocalc parallel smoke gate failed" >&2
+    exit 1
+fi
+
+# trace smoke gate (ISSUE 5): the spheroid fixture through the real
+# in-process service with tracing on must yield a schema-valid,
+# Perfetto-loadable trace that scripts/trace_report.py renders
+if ! env JAX_PLATFORMS=cpu python scripts/trace_smoke.py; then
+    echo "check_tier1: FAIL — trace smoke gate failed" >&2
     exit 1
 fi
 

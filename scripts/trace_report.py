@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Trace-driven performance report (ISSUE 5 capstone).
+
+Renders a per-job trace (utils/tracing.py JSONL, or fetched live from a
+running service) into the standard perf artifact for this repo:
+
+- the **phase breakdown** — wall clock per pipeline phase, as a share of
+  the root ``submit`` span (submit → terminal);
+- the **accounting split** — queue wait (submit → first attempt), device-
+  token wait (device_hold start → token acquired), device-token hold, and
+  compute (the ``score`` phase), so a throughput cliff shows WHERE the
+  time moved (scheduler? token contention? device?);
+- the **slowest batches** — the top score_batch spans with backend/ion
+  counts, the needle for per-batch regressions;
+- attempts (with timeout/abandon flags) and event counts (retries,
+  cancels, failpoints, breaker flips).
+
+Every future perf PR attaches this report instead of a bare before/after
+total.  Usage::
+
+    python scripts/trace_report.py WORKDIR/traces/<trace_id>.jsonl
+    python scripts/trace_report.py --url http://127.0.0.1:8685 --job MSG_ID
+    python scripts/trace_report.py TRACE.jsonl --json      # machine-readable
+    python scripts/trace_report.py TRACE.jsonl --validate  # schema-gate too
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from sm_distributed_tpu.utils import tracing  # noqa: E402
+
+# phases in pipeline order (anything else traced as a phase appends after)
+_PHASE_ORDER = ("stage_input", "read_dataset", "decoy_selection",
+                "isotope_patterns", "score", "fdr", "store_results")
+_TOP_BATCHES = 10
+
+
+def load_records(args) -> list[dict]:
+    if args.url:
+        import urllib.request
+
+        url = f"{args.url.rstrip('/')}/jobs/{args.job}/trace?raw=1"
+        with urllib.request.urlopen(url, timeout=30.0) as r:
+            body = json.loads(r.read())
+        return body.get("records", [])
+    return tracing.read_trace(args.trace)
+
+
+def _spans(records, name=None):
+    for r in records:
+        if r.get("kind") == "span" and (name is None or r.get("name") == name):
+            yield r
+
+
+def _events(records, name=None):
+    for r in records:
+        if r.get("kind") == "event" and (name is None or r.get("name") == name):
+            yield r
+
+
+def summarize(records: list[dict]) -> dict:
+    """The report's data model (also what --json prints)."""
+    root = max(_spans(records, "submit"),
+               key=lambda r: float(r.get("dur", 0.0)), default=None)
+    total = float(root["dur"]) if root else sum(
+        float(r.get("dur", 0.0)) for r in _spans(records)
+        if not r.get("parent_id"))
+    phases: dict[str, dict] = {}
+    for r in _spans(records):
+        if not (r.get("attrs") or {}).get("phase"):
+            continue
+        p = phases.setdefault(r["name"], {"count": 0, "seconds": 0.0})
+        p["count"] += 1
+        p["seconds"] += float(r["dur"])
+    attempts = sorted(_spans(records, "attempt"), key=lambda r: r["ts"])
+    # queue wait: submit start -> first attempt start (requeues/retries put
+    # later attempts' wait inside the root too, reported via attempts[])
+    queue_wait = (attempts[0]["ts"] - root["ts"]) if (root and attempts) \
+        else None
+    holds = list(_spans(records, "device_hold"))
+    token_hold = sum(float(r["dur"]) for r in holds)
+    token_wait = 0.0
+    acquired = sorted(_events(records, "device_token_acquired"),
+                      key=lambda r: r["ts"])
+    for h in sorted(holds, key=lambda r: r["ts"]):
+        acq = next((e for e in acquired
+                    if h["ts"] <= e["ts"] <= h["ts"] + float(h["dur"])), None)
+        if acq is not None:
+            token_wait += acq["ts"] - h["ts"]
+    batches = sorted(_spans(records, "score_batch"),
+                     key=lambda r: float(r["dur"]), reverse=True)
+    events: dict[str, int] = {}
+    for r in _events(records):
+        events[r["name"]] = events.get(r["name"], 0) + 1
+    worker_spans = list(_spans(records, "isocalc_chunk"))
+    return {
+        "trace_id": records[0].get("trace_id", "") if records else "",
+        "job_id": next((r["job_id"] for r in records if r.get("job_id")), ""),
+        "state": (root.get("attrs") or {}).get("state", "") if root else "",
+        "total_s": total,
+        "phases": {k: {"count": v["count"],
+                       "seconds": round(v["seconds"], 6)}
+                   for k, v in phases.items()},
+        "accounting": {
+            "queue_wait_s": round(queue_wait, 6)
+            if queue_wait is not None else None,
+            "device_token_wait_s": round(token_wait, 6),
+            "device_token_hold_s": round(token_hold, 6),
+            "compute_s": round(phases.get("score", {}).get("seconds", 0.0), 6),
+            "isocalc_gen_s": round(sum(
+                float(r["dur"]) for r in _spans(records, "isocalc_gen")), 6),
+        },
+        "attempts": [{
+            "attempt": (r.get("attrs") or {}).get("attempt"),
+            "seconds": round(float(r["dur"]), 6),
+            "timed_out": bool((r.get("attrs") or {}).get("timed_out")),
+            "abandoned": bool((r.get("attrs") or {}).get("abandoned")),
+        } for r in attempts],
+        "slowest_batches": [{
+            "seconds": round(float(r["dur"]), 6),
+            "backend": (r.get("attrs") or {}).get("backend", ""),
+            "ions": (r.get("attrs") or {}).get("ions"),
+            "pid": r.get("pid"), "tid": r.get("tid"),
+        } for r in batches[:_TOP_BATCHES]],
+        "n_batches": len(batches),
+        "n_isocalc_worker_spans": len(worker_spans),
+        "events": events,
+        "n_records": len(records),
+    }
+
+
+def _pct(part: float, total: float) -> str:
+    return f"{100.0 * part / total:5.1f}%" if total > 0 else "    -"
+
+
+def render(s: dict) -> str:
+    lines = []
+    head = f"trace {s['trace_id']}"
+    if s["job_id"]:
+        head += f" · job {s['job_id']}"
+    if s["state"]:
+        head += f" · {s['state']}"
+    lines.append(head)
+    lines.append(f"total (submit → terminal): {s['total_s']:.3f}s over "
+                 f"{s['n_records']} records")
+    lines.append("")
+    lines.append("phase breakdown:")
+    total = s["total_s"]
+    ordered = [p for p in _PHASE_ORDER if p in s["phases"]]
+    ordered += [p for p in sorted(s["phases"]) if p not in ordered]
+    for p in ordered:
+        v = s["phases"][p]
+        lines.append(f"  {p:<22} {v['seconds']:9.3f}s "
+                     f"{_pct(v['seconds'], total)}  x{v['count']}")
+    if not ordered:
+        lines.append("  (no phase spans)")
+    lines.append("")
+    a = s["accounting"]
+    lines.append("accounting (where the wall went):")
+    if a["queue_wait_s"] is not None:
+        lines.append(f"  queue wait             {a['queue_wait_s']:9.3f}s "
+                     f"{_pct(a['queue_wait_s'], total)}")
+    lines.append(f"  device-token wait      {a['device_token_wait_s']:9.3f}s "
+                 f"{_pct(a['device_token_wait_s'], total)}")
+    lines.append(f"  device-token hold      {a['device_token_hold_s']:9.3f}s "
+                 f"{_pct(a['device_token_hold_s'], total)}")
+    lines.append(f"  compute (score)        {a['compute_s']:9.3f}s "
+                 f"{_pct(a['compute_s'], total)}")
+    lines.append(f"  isocalc generation     {a['isocalc_gen_s']:9.3f}s "
+                 f"(overlaps other phases)")
+    lines.append("")
+    if s["attempts"]:
+        flags = ", ".join(
+            f"#{at['attempt']}: {at['seconds']:.3f}s"
+            + (" TIMED-OUT" if at["timed_out"] else "")
+            + (" ABANDONED" if at["abandoned"] else "")
+            for at in s["attempts"])
+        lines.append(f"attempts ({len(s['attempts'])}): {flags}")
+    if s["n_batches"]:
+        lines.append(f"slowest batches (of {s['n_batches']}):")
+        for b in s["slowest_batches"]:
+            lines.append(f"  {b['seconds']:9.3f}s  {b['backend']:<16} "
+                         f"ions={b['ions']}  pid={b['pid']}")
+    lines.append(f"isocalc worker spans: {s['n_isocalc_worker_spans']}")
+    if s["events"]:
+        lines.append("events: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(s["events"].items())))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("trace", nargs="?", default=None,
+                    help="per-job trace JSONL file")
+    ap.add_argument("--url", default=None,
+                    help="live service base URL (with --job)")
+    ap.add_argument("--job", default=None, help="msg_id to fetch from --url")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable summary")
+    ap.add_argument("--validate", action="store_true",
+                    help="also schema-validate every record (exit 1 on any "
+                         "problem) — the trace smoke gate's mode")
+    args = ap.parse_args(argv)
+    if bool(args.url) == bool(args.trace):
+        ap.error("give exactly one of TRACE or --url/--job")
+    if args.url and not args.job:
+        ap.error("--url needs --job")
+    records = load_records(args)
+    if not records:
+        print("trace_report: no records found", file=sys.stderr)
+        return 1
+    if args.validate:
+        problems = tracing.validate_records(records)
+        if problems:
+            print("trace_report: schema problems:\n  "
+                  + "\n  ".join(problems), file=sys.stderr)
+            return 1
+    summary = summarize(records)
+    print(json.dumps(summary, indent=2) if args.json else render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,11 @@ def _load_configs(args) -> SMConfig:
     import os
 
     sm = SMConfig.set_path(args.sm_config) if args.sm_config else SMConfig.get_conf()
-    init_logger(sm.logs_dir or None)
+    init_logger(sm.logs_dir or None, json_logs=sm.logs.json)
+    from ..utils import tracing
+
+    tracing.configure(enabled=sm.tracing.enabled,
+                      ring_size=sm.tracing.ring_size)
     if sm.failpoints and not os.environ.get("SM_FAILPOINTS"):
         # config-file activation (env always wins — it was applied at import)
         from ..utils import failpoints
@@ -57,6 +61,7 @@ def cmd_run(args) -> int:
         db = MolecularDB(JobLedger(sm_config.storage.results_dir))
         db.import_csv(args.formulas_csv, name=Path(args.formulas_csv).stem, version="cli")
         formulas = db.formulas(Path(args.formulas_csv).stem, "cli")
+    from ..utils import tracing
     from .search_job import SearchJob
 
     job = SearchJob(
@@ -68,7 +73,25 @@ def cmd_run(args) -> int:
         formulas=formulas,
         profile_dir=args.profile,
     )
-    bundle = job.run(clean=args.clean)
+    # offline runs get the same end-to-end trace a /submit job gets — the
+    # root is minted at CLI entry instead (ISSUE 5; docs/OBSERVABILITY.md)
+    trace = (tracing.new_trace(job_id=job.ds_id,
+                               trace_dir=sm_config.trace_dir)
+             if sm_config.tracing.enabled else None)
+    import time as _time
+
+    t0 = _time.time()
+    with tracing.attach(trace):
+        try:
+            bundle = job.run(clean=args.clean)
+        finally:
+            if trace is not None:
+                tracing.emit_span(trace, "submit", ts=t0,
+                                  dur=_time.time() - t0,
+                                  span_id=trace.span_id, ds_id=job.ds_id,
+                                  entry="cli")
+                logger.info("trace written to %s (scripts/trace_report.py "
+                            "renders it)", trace.file)
     n_pass = int((bundle.annotations.fdr_level <= 0.1).sum())
     logger.info(
         "done: %d target ions scored, %d at FDR<=10%%",

@@ -269,12 +269,13 @@ def annotate_callback(sm_config: SMConfig, residency=None):
         residency = DatasetResidency(max_datasets=n, max_backends=n)
 
     def cb(msg: dict, ctx=None) -> None:
+        from ..utils import tracing
         from .search_job import SearchJob
 
         ds_config = (
             DSConfig.from_dict(msg["ds_config"]) if msg.get("ds_config") else DSConfig()
         )
-        SearchJob(
+        job = SearchJob(
             ds_id=msg["ds_id"],
             ds_name=msg.get("ds_name", msg["ds_id"]),
             input_path=msg["input_path"],
@@ -288,7 +289,12 @@ def annotate_callback(sm_config: SMConfig, residency=None):
             # cooperative cancellation: the job checks this at phase and
             # checkpoint-group boundaries (utils/cancel.py)
             cancel=getattr(ctx, "cancel", None),
-        ).run(clean=bool(msg.get("clean")))
+        )
+        # the scheduler's attempt-span context (already ambient when the
+        # scheduler ran this in an _Attempt thread; attached here too so the
+        # plain blocking daemon's traced messages behave the same)
+        with tracing.attach(getattr(ctx, "trace", None) or tracing.current()):
+            job.run(clean=bool(msg.get("clean")))
 
     return cb
 
@@ -304,7 +310,7 @@ def main(argv: list[str] | None = None) -> int:
     sm_config = SMConfig.set_path(args.sm_config) if args.sm_config else SMConfig.get_conf()
     from ..utils.logger import init_logger
 
-    init_logger(sm_config.logs_dir or None)
+    init_logger(sm_config.logs_dir or None, json_logs=sm_config.logs.json)
     if sm_config.failpoints and not os.environ.get("SM_FAILPOINTS"):
         from ..utils import failpoints
 

@@ -20,6 +20,7 @@ from pathlib import Path
 
 from ..io.dataset import SpectralDataset
 from ..models.msm_basic import IsotopePrefetch, MSMBasicSearch, SearchResultsBundle
+from ..utils import tracing
 from ..utils.cancel import JobCancelledError, hold_cancellable
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger, phase_timer
@@ -126,6 +127,9 @@ class SearchJob:
 
                 prof = self.profile_dir
                 jax.profiler.start_trace(prof)
+                # correlate the jax.profiler trace dir into the job trace:
+                # /jobs/<id>/trace surfaces it in otherData.jax_profile_dir
+                tracing.event("jax_profile", dir=str(self.profile_dir))
             import contextlib
 
             # everything up to here is CPU-bound (staging, parse, formula
@@ -139,7 +143,11 @@ class SearchJob:
                 token = contextlib.nullcontext()
             else:
                 token = hold_cancellable(self.device_token, self.cancel)
-            with token:
+            # trace accounting: the device_hold span covers token WAIT +
+            # HOLD; the acquired event inside marks the boundary, so
+            # trace_report can split queue-wait vs token-wait vs compute
+            with tracing.span("device_hold"), token:
+                tracing.event("device_token_acquired")
                 search = MSMBasicSearch(
                     ds, formulas, self.ds_config, self.sm_config,
                     isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),

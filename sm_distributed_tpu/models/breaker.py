@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..utils import tracing
 from ..utils.logger import logger
 
 STATE_CLOSED = "closed"
@@ -60,6 +61,10 @@ class CircuitBreaker:
             del self.transitions[:-256]
         logger.warning("device breaker: %s -> %s (%d consecutive failures)",
                        self._state, to, self._failures)
+        # trace/flight-recorder visibility (ISSUE 5): attached to the job
+        # span that tripped it when one is ambient, ring-only otherwise
+        tracing.event("breaker", from_state=self._state, to_state=to,
+                      failures=self._failures)
         self._state = to
         _export_state(to)
 

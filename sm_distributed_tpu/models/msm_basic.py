@@ -27,6 +27,7 @@ from ..ops.isocalc import (
     IsocalcWrapper,
     IsotopePatternTable,
 )
+from ..utils import tracing
 from ..utils.cancel import JobCancelledError
 from ..utils.config import DSConfig, SMConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
@@ -124,6 +125,11 @@ class NumpyBackend:
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         """(n_ions, 4) array of (chaos, spatial, spectral, msm)."""
+        with tracing.span("score_batch", backend=self.name,
+                          ions=int(table.n_ions)):
+            return self._score_batch(table)
+
+    def _score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         img_cfg = self.ds_config.image_generation
         images = extract_ion_images(self._view, table, img_cfg.ppm)
         out = np.zeros((table.n_ions, 4))
@@ -198,6 +204,9 @@ class IsotopePrefetch:
         self.isocalc: IsocalcWrapper | None = None
         self.stream = None
         self._error: BaseException | None = None
+        # thread hop: capture the caller's (SearchJob attempt) trace context
+        # so prefetch setup + the generation stream trace into the job
+        self._trace = tracing.current()
         self._thread = threading.Thread(
             target=self._run, name="isotope-prefetch", daemon=True)
         self._thread.start()
@@ -206,25 +215,32 @@ class IsotopePrefetch:
         import time
 
         try:
-            iso_cfg = self.ds_config.isotope_generation
-            fdr_cfg = self.sm_config.fdr
-            self.fdr = FDR(
-                decoy_sample_size=fdr_cfg.decoy_sample_size,
-                target_adducts=iso_cfg.adducts,
-                seed=fdr_cfg.seed,
-            )
-            t0 = time.perf_counter()
-            self.assignment = self.fdr.decoy_adduct_selection(self.formulas)
-            self.pairs, self.flags = self.assignment.all_ion_tuples(
-                self.formulas, iso_cfg.adducts)
-            self.timings["decoy_selection"] = time.perf_counter() - t0
-            # wrapper construction loads the cache shards (warm: seconds at
-            # 1.68M ions) — deliberately inside this thread too
-            self.isocalc = make_isocalc(
-                self.ds_config, self.sm_config, self.cache_dir)
-            self.stream = self.isocalc.stream_table(self.pairs, self.flags)
+            with tracing.attach(self._trace), \
+                    tracing.span("isotope_prefetch_setup"):
+                self._setup()
         except BaseException as exc:  # noqa: BLE001 — result() re-raises
             self._error = exc
+
+    def _setup(self) -> None:
+        import time
+
+        iso_cfg = self.ds_config.isotope_generation
+        fdr_cfg = self.sm_config.fdr
+        self.fdr = FDR(
+            decoy_sample_size=fdr_cfg.decoy_sample_size,
+            target_adducts=iso_cfg.adducts,
+            seed=fdr_cfg.seed,
+        )
+        t0 = time.perf_counter()
+        self.assignment = self.fdr.decoy_adduct_selection(self.formulas)
+        self.pairs, self.flags = self.assignment.all_ion_tuples(
+            self.formulas, iso_cfg.adducts)
+        self.timings["decoy_selection"] = time.perf_counter() - t0
+        # wrapper construction loads the cache shards (warm: seconds at
+        # 1.68M ions) — deliberately inside this thread too
+        self.isocalc = make_isocalc(
+            self.ds_config, self.sm_config, self.cache_dir)
+        self.stream = self.isocalc.stream_table(self.pairs, self.flags)
 
     def result(self):
         """(fdr, assignment, stream) — blocks on setup only."""
@@ -675,11 +691,15 @@ class MSMBasicSearch:
                 # device-fault seam: a preempted TPU / failed XLA launch
                 # surfaces here, after `done` groups are already durable
                 failpoint(FP_DEVICE_SCORE)
-                backend, degraded = self._score_group(
-                    backend, table, metrics, group, breaker, use_device,
-                    degraded)
+                with tracing.span("score_group", group=gi,
+                                  rows=list(row_ranges[gi]) if row_ranges
+                                  else None, degraded=degraded):
+                    backend, degraded = self._score_group(
+                        backend, table, metrics, group, breaker, use_device,
+                        degraded)
                 if ckpt is not None:
-                    ckpt.save(metrics, gi, len(groups), row_ranges)
+                    with tracing.span("checkpoint_save", group=gi):
+                        ckpt.save(metrics, gi, len(groups), row_ranges)
             # NOT finalized here: downstream FDR/storage can still fail, and
             # the scored metrics must survive a rerun.  The orchestrator
             # (SearchJob) finalizes after results are durably persisted; a

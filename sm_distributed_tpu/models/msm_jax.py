@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..io.dataset import SpectralDataset
+from ..utils import tracing
 from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
 )
@@ -907,12 +908,25 @@ class JaxBackend:
         if cancel is not None:
             cancel.check("score_batches")
         if self.mz_chunk:
-            return fetch_scored_batches([self._dispatch(t) for t in tables])
+            pending = [self._enqueue_traced(t) for t in tables]
+            with tracing.span("device_sync", batches=len(pending)):
+                return fetch_scored_batches(pending)
         # plan every batch up front: pre-sizes the static shapes (band width,
         # compaction capacities) to the stream's max so ONE executable serves
         # every batch (a mid-stream growth would recompile, ~15 s through a
         # tunneled TPU), and each plan is reused by its dispatch
         plans = [self._flat_plan(t) for t in tables]
         self._grow_for_stream(plans)
-        return fetch_scored_batches(
-            [self._dispatch(t, plan) for t, plan in zip(tables, plans)])
+        pending = [self._enqueue_traced(t, plan)
+                   for t, plan in zip(tables, plans)]
+        with tracing.span("device_sync", batches=len(pending)):
+            return fetch_scored_batches(pending)
+
+    def _enqueue_traced(self, table, plan=None):
+        """One async device dispatch, wrapped in a per-batch scoring span.
+        The span measures ENQUEUE time (dispatch is async; device compute
+        overlaps the stream and is settled by the device_sync span)."""
+        with tracing.span("score_batch", backend="jax_tpu",
+                          ions=int(table.n_ions), enqueue=True):
+            return self._dispatch(table, plan) if plan is not None \
+                else self._dispatch(table)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import threading
 import zipfile
 import zlib
@@ -37,6 +38,7 @@ import numpy as np
 
 from . import elements
 from .formula import FormulaError, apply_adduct, parse_formula
+from ..utils import tracing
 from ..utils.config import IsotopeGenerationConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
@@ -407,7 +409,8 @@ def _compute_chunk(args):
     """Compute one deterministic chunk of (sf, adduct) pairs.
 
     Runs in a spawned pool worker (large jobs) or inline (small jobs / the
-    after-retries fallback).  Returns ``(ci, outputs)`` where each output is
+    after-retries fallback).  Returns ``(ci, outputs, trace_records)`` where
+    each output is
 
     - ``("pat", ion, mzs, ints)`` — a finished host-computed pattern, or
     - ``("seg", ion, segments)`` — fine-structure segments for the device
@@ -415,8 +418,25 @@ def _compute_chunk(args):
       via the exact oracle), or
     - ``None`` for invalid chemistry (callers pre-validate, so only single-
       ion paths ever see it).
+
+    ``trace_records`` (ISSUE 5): when the driver passed a wire trace
+    context, the chunk's span is recorded into a capture buffer — the
+    worker process has no sinks — and returned for the driver to emit
+    ("re-parented on return"; a crashed worker's records die with it, and
+    the retried chunk traces again).
     """
-    ci, pairs, params, device = args
+    ci, pairs, params, device, wire = args
+    ctx = tracing.TraceContext.from_wire(wire)
+    if ctx is None:
+        return ci, _compute_chunk_body(ci, pairs, params, device), []
+    with tracing.capture() as records:
+        with tracing.span("isocalc_chunk", ctx=ctx, ci=ci,
+                          n_pairs=len(pairs), worker_pid=os.getpid()):
+            out = _compute_chunk_body(ci, pairs, params, device)
+    return ci, out, records
+
+
+def _compute_chunk_body(ci, pairs, params, device):
     failpoint(FP_ISO_WORKER)
     charge, sigma, pts_per_mz, n_peaks = params
     out = []
@@ -435,7 +455,7 @@ def _compute_chunk(args):
                 continue
         mzs, ints = centroids(counts, charge, sigma, pts_per_mz, n_peaks)
         out.append(("pat", ion, mzs, ints))
-    return ci, out
+    return out
 
 
 # -- progress / metrics hooks (mirrors utils/failpoints.attach_metrics) ------
@@ -561,6 +581,9 @@ class PatternStream:
         self._job_tag = hashlib.sha256(
             "\x00".join(f"{sf}{ad}" for sf, ad in missing).encode()
         ).hexdigest()[:8]
+        # thread hop: generation runs in its own thread — capture the
+        # caller's trace context so chunk/worker spans land in the job trace
+        self._trace = tracing.current()
         self._thread = threading.Thread(
             target=self._run, name="isocalc-stream", daemon=True)
         self._thread.start()
@@ -634,7 +657,10 @@ class PatternStream:
         t0 = time.perf_counter()
         try:
             if self._chunks:
-                self._generate()
+                with tracing.attach(self._trace), \
+                        tracing.span("isocalc_gen", missing=self.n_missing,
+                                     chunks=len(self._chunks)):
+                    self._generate()
             with self.wrapper._lock:
                 self.wrapper._maybe_compact()
         except BaseException as exc:  # noqa: BLE001 — consumer re-raises
@@ -658,11 +684,15 @@ class PatternStream:
             self._done = True
             self._cond.notify_all()
 
-    def _deliver(self, ci: int, outputs: list) -> None:
+    def _deliver(self, ci: int, outputs: list,
+                 records: list | None = None) -> None:
         """Commit one completed chunk: device-finish segment outputs, write
-        the chunk's cache shard, fill its table rows, advance the prefix."""
+        the chunk's cache shard, fill its table rows, advance the prefix.
+        ``records`` are the worker's captured trace spans — emitted here,
+        in the driver that owns the sinks (re-parented on return)."""
         import time
 
+        tracing.emit_records(records, tracing.current())
         entries: dict[str, tuple[np.ndarray, np.ndarray]] = {}
         seg_ions = [(o[1], o[2]) for o in outputs
                     if o is not None and o[0] == "seg"]
@@ -704,20 +734,27 @@ class PatternStream:
         device = wrapper.device_blur
         use_pool = (self.n_missing >= _PARALLEL_THRESHOLD and n_procs > 1)
         self.workers = n_procs if use_pool else 1
-        buffered: dict[int, list] = {}
+        buffered: dict[int, tuple] = {}
         next_ci = 0
+        # process-hop trace context for workers (ambient here = the
+        # isocalc_gen span attached by _run); None keeps workers untraced
+        ctx = tracing.current()
+        wire = ctx.to_wire() if ctx is not None else None
 
         def commit_ready() -> None:
             nonlocal next_ci
             while next_ci in buffered:
-                self._deliver(next_ci, buffered.pop(next_ci))
+                outputs, records = buffered.pop(next_ci)
+                self._deliver(next_ci, outputs, records)
                 next_ci += 1
 
         if not use_pool:
             for ci, chunk in enumerate(self._chunks):
                 if self._cancel.is_set():
                     return
-                buffered[ci] = _compute_chunk((ci, chunk, params, device))[1]
+                _ci, outputs, records = _compute_chunk(
+                    (ci, chunk, params, device, wire))
+                buffered[ci] = (outputs, records)
                 commit_ready()
             return
 
@@ -741,14 +778,15 @@ class PatternStream:
                 initializer=_pool_init, initargs=(spec,))
             try:
                 futs = {ex.submit(_compute_chunk,
-                                  (ci, self._chunks[ci], params, device)): ci
+                                  (ci, self._chunks[ci], params, device,
+                                   wire)): ci
                         for ci in sorted(remaining)}
                 for fut in as_completed(futs):
                     ci = futs[fut]
                     if self._cancel.is_set():
                         return
                     try:
-                        _ci, outputs = fut.result()
+                        _ci, outputs, records = fut.result()
                     except BrokenProcessPool:
                         # a worker died (crash/OOM): every pending future is
                         # poisoned — rebuild the pool for what's left
@@ -766,7 +804,7 @@ class PatternStream:
                                        "will retry", ci, exc_info=True)
                         continue
                     remaining.discard(ci)
-                    buffered[ci] = outputs
+                    buffered[ci] = (outputs, records)
                     commit_ready()
             finally:
                 ex.shutdown(wait=False, cancel_futures=True)
@@ -776,8 +814,9 @@ class PatternStream:
             if self._cancel.is_set():
                 return
             record_recovery("isocalc.chunk_inline")
-            buffered[ci] = _compute_chunk(
-                (ci, self._chunks[ci], params, device))[1]
+            _ci, outputs, records = _compute_chunk(
+                (ci, self._chunks[ci], params, device, wire))
+            buffered[ci] = (outputs, records)
             commit_ready()
 
 

@@ -54,6 +54,7 @@ from ..ops.imager_jax import (
 )
 from ..ops.isocalc import IsotopePatternTable
 from ..ops.metrics_jax import batch_metrics
+from ..utils import tracing
 from ..ops.quantize import quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
@@ -551,8 +552,13 @@ class ShardedJaxBackend:
             cancel.check("score_batches")
         plans = [self._flat_plan(t) for t in tables]
         self._grow_static_shapes(plans)
-        return fetch_scored_batches(
-            [self._dispatch(t, plan) for t, plan in zip(tables, plans)])
+        pending = []
+        for t, plan in zip(tables, plans):
+            with tracing.span("score_batch", backend="jax_tpu_sharded",
+                              ions=int(t.n_ions), enqueue=True):
+                pending.append(self._dispatch(t, plan))
+        with tracing.span("device_sync", batches=len(pending)):
+            return fetch_scored_batches(pending)
 
     def _grow_static_shapes(self, plans) -> None:
         # fixpoint, like JaxBackend._grow_for_stream: growing the compact

@@ -21,7 +21,14 @@ RabbitMQ's management UI):
 - ``DELETE /jobs/<id>``  cooperative cancel: a queued message terminates
   immediately, a running attempt unwinds at its next checkpoint boundary
   (``utils/cancel.py``); 202 while cancelling, 200 when already terminal-
-  cancelled here, 409 for finished jobs, 404 for unknown ids.
+  cancelled here, 409 for finished jobs, 404 for unknown ids;
+- ``GET /jobs/<id>/trace``  the job's end-to-end trace (utils/tracing.py)
+  as Chrome trace-event JSON — Perfetto-loadable, one root ``submit`` span
+  covering admission → claim → every SearchJob phase → per-batch scoring →
+  isocalc workers → store_results.  ``?raw=1`` returns the raw records;
+- ``GET /debug/events?n=``  the most recent N flight-recorder records
+  (default 256) — every span/event from every job plus traceless service
+  events (admission sheds, breaker flips).
 
 ``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
 handler is read-only except ``/submit`` (appends to ``pending/``) and
@@ -36,6 +43,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ..utils import tracing
 from ..utils.logger import logger
 
 # message fields /submit validates beyond the publisher's ds_id/input_path
@@ -122,6 +130,18 @@ class AdminAPI:
                     elif url.path == "/jobs":
                         q = parse_qs(url.query)
                         self._reply_json(200, api._jobs(q.get("state", [None])[0]))
+                    elif url.path == "/debug/events":
+                        q = parse_qs(url.query)
+                        n = int(q.get("n", ["256"])[0] or 256)
+                        self._reply_json(
+                            200, tracing.flight_recorder.recent(n))
+                    elif (parts := url.path.strip("/").split("/"))[0] == \
+                            "jobs" and len(parts) == 3 and parts[2] == "trace":
+                        q = parse_qs(url.query)
+                        status, body = api._trace(
+                            parts[1], raw=q.get("raw", ["0"])[0] not in
+                            ("0", "", "false"))
+                        self._reply_json(status, body)
                     else:
                         self._reply_json(404, {"error": "not found"})
                 except Exception as exc:  # noqa: BLE001
@@ -210,6 +230,10 @@ class AdminAPI:
         adm = getattr(svc, "admission", None)
         decision = adm.try_admit(tenant) if adm is not None else None
         if decision is not None and not decision.accepted:
+            # traceless flight-recorder event: the shed job never gets a
+            # trace, but GET /debug/events still shows WHY it bounced
+            tracing.event("admission.shed", reason=decision.body().get(
+                "reason", ""), tenant=tenant, status=decision.status)
             return decision.status, decision.body(), \
                 {"Retry-After": str(max(1, int(round(decision.retry_after_s))))}
         try:
@@ -220,6 +244,16 @@ class AdminAPI:
                 service_block.setdefault(
                     "deadline_at", time.time() + float(msg["deadline_s"]))
                 msg["service"] = service_block
+            # mint the job's trace HERE (ISSUE 5): the ids travel inside the
+            # message, so the scheduler — this process or the one after a
+            # crash — continues the same trace file end to end
+            service_block = dict(msg.get("service", {}))
+            trace = service_block.get("trace")
+            if not (isinstance(trace, dict) and trace.get("trace_id")):
+                trace = {"trace_id": tracing.new_id(),
+                         "span": tracing.new_id(), "start": time.time()}
+                service_block["trace"] = trace
+                msg["service"] = service_block
             dst = svc.publisher.publish(msg)
         except (ValueError, OSError) as exc:
             if decision is not None:
@@ -227,7 +261,54 @@ class AdminAPI:
             return 400, {"error": str(exc), "reason": "invalid_message"}, None
         if decision is not None:
             adm.confirm(dst.stem, tenant)
-        return 202, {"msg_id": dst.stem, "spooled": str(dst)}, None
+        trace_dir = getattr(svc, "trace_dir", None)
+        ctx = tracing.TraceContext(
+            trace_id=trace["trace_id"], span_id=trace["span"],
+            job_id=dst.stem,
+            file=str(tracing.trace_path(trace_dir, trace["trace_id"]))
+            if trace_dir else "")
+        tracing.event("submit", ctx=ctx, tenant=tenant,
+                      ds_id=str(msg.get("ds_id", "")),
+                      priority=str(msg.get("priority", "normal")))
+        return 202, {"msg_id": dst.stem, "spooled": str(dst),
+                     "trace_id": trace["trace_id"]}, None
+
+    def _trace(self, msg_id: str, raw: bool = False) -> tuple[int, dict]:
+        """``GET /jobs/<id>/trace``: resolve msg_id → trace_id (scheduler
+        record first, then the message file in any spool state), read the
+        per-job JSONL, return Chrome trace JSON (or raw records)."""
+        svc = self.service
+        trace_id = next((j["trace_id"] for j in svc.scheduler.jobs()
+                         if j["msg_id"] == msg_id and j.get("trace_id")), "")
+        if not trace_id:
+            # not claimed yet (or a restarted service): the ids live in the
+            # spool message itself
+            root = svc.queue_dir / svc.queue
+            for state in ("pending", "running", "done", "failed",
+                          "quarantine"):
+                p = root / state / f"{msg_id}.json"
+                try:
+                    msg = json.loads(p.read_text())
+                    trace_id = str(msg.get("service", {})
+                                   .get("trace", {}).get("trace_id", ""))
+                    if trace_id:
+                        break
+                except (OSError, json.JSONDecodeError, AttributeError):
+                    continue
+        if not trace_id:
+            return 404, {"error": f"no trace for job {msg_id!r}",
+                         "reason": "not_found"}
+        trace_dir = getattr(svc, "trace_dir", None)
+        path = tracing.trace_path(trace_dir, trace_id) if trace_dir else None
+        records = tracing.read_trace(path) if path else []
+        if not records:
+            return 404, {"error": f"trace file for {trace_id} is empty or "
+                                  "missing", "reason": "not_found",
+                         "trace_id": trace_id}
+        if raw:
+            return 200, {"trace_id": trace_id, "msg_id": msg_id,
+                         "records": records}
+        return 200, tracing.to_chrome_trace(records)
 
     def _cancel(self, msg_id: str) -> tuple[int, dict]:
         disposition = self.service.scheduler.cancel(msg_id)

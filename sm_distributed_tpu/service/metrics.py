@@ -237,6 +237,72 @@ def rate_collector(registry: "MetricsRegistry", name: str, help: str,
     registry.add_collector(collect)
 
 
+def build_info_collector(registry: "MetricsRegistry", backend: str) -> None:
+    """``sm_build_info{version=,jax_version=,backend=} 1`` — the constant
+    gauge dashboards join on (the Prometheus build-info idiom).  Versions
+    come from installed-package metadata so no heavy import happens at
+    scrape time."""
+    from importlib import metadata
+
+    def _ver(dist: str, fallback: str) -> str:
+        try:
+            return metadata.version(dist)
+        except metadata.PackageNotFoundError:
+            return fallback
+
+    version = _ver("sm-distributed-tpu", "dev")
+    if version == "dev":
+        try:
+            from .. import __version__ as version  # source checkout
+        except ImportError:
+            pass
+    jax_version = _ver("jax", "unknown")
+    registry.gauge("sm_build_info",
+                   "Build identity (constant 1; the labels are the data)",
+                   ("version", "jax_version", "backend")).labels(
+        version=version, jax_version=jax_version, backend=backend).set(1)
+
+
+def process_collector(registry: "MetricsRegistry") -> None:
+    """Scrape-time process gauges: RSS bytes, thread count, open FDs —
+    the leak signals (ISSUE 5 satellite) the load sweep only catches in
+    tests.  /proc is preferred; platforms without it fall back to
+    ``resource`` for RSS and skip the FD gauge."""
+    import os
+
+    def collect(reg: "MetricsRegistry") -> None:
+        rss = 0.0
+        try:
+            with open("/proc/self/statm") as f:
+                rss = float(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+        except (OSError, IndexError, ValueError):
+            try:
+                import resource
+
+                # ru_maxrss is KiB on Linux (peak, not current — still a
+                # usable leak signal on /proc-less platforms)
+                rss = float(resource.getrusage(
+                    resource.RUSAGE_SELF).ru_maxrss) * 1024.0
+            except Exception:
+                pass
+        if rss:
+            reg.gauge("sm_process_resident_memory_bytes",
+                      "Resident set size of the service process").set(rss)
+        reg.gauge("sm_process_threads",
+                  "Live threads in the service process").set(
+            threading.active_count())
+        try:
+            n_fds = len(os.listdir("/proc/self/fd"))
+        except OSError:
+            n_fds = 0
+        if n_fds:
+            reg.gauge("sm_process_open_fds",
+                      "Open file descriptors in the service process").set(
+                n_fds)
+
+    registry.add_collector(collect)
+
+
 class MetricsRegistry:
     """Registry: owns metric families + scrape-time collect callbacks."""
 

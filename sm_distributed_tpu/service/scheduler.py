@@ -67,6 +67,7 @@ from ..engine.daemon import (
     clear_heartbeat,
     sweep_orphan_tmp,
 )
+from ..utils import tracing
 from ..utils.cancel import CancelToken, DeadlineExceededError, JobCancelledError
 from ..utils.config import ServiceConfig
 from ..utils.failpoints import failpoint, register_failpoint
@@ -139,6 +140,7 @@ class JobRecord:
     deadline_at: float = 0.0
     cancel_requested: str = ""     # "" | "user" (DELETE /jobs/<id>)
     error: str = ""
+    trace_id: str = ""             # end-to-end trace (GET /jobs/<id>/trace)
 
     def to_dict(self) -> dict:
         return {
@@ -150,6 +152,7 @@ class JobRecord:
             "next_retry_at": self.next_retry_at,
             "deadline_at": self.deadline_at,
             "cancel_requested": self.cancel_requested, "error": self.error,
+            "trace_id": self.trace_id,
         }
 
 
@@ -164,6 +167,10 @@ class JobContext:
     # cooperative cancellation: callbacks check this at phase / checkpoint-
     # group boundaries (utils/cancel.CancelToken; None for legacy callers)
     cancel: object = field(repr=False, default=None)
+    # end-to-end tracing (utils/tracing.TraceContext for THIS attempt's
+    # span): callbacks attach it so every phase/batch span lands in the
+    # job's trace; None for legacy callers
+    trace: object = field(repr=False, default=None)
 
 
 def _callback_takes_ctx(fn) -> bool:
@@ -200,10 +207,13 @@ class _Attempt(threading.Thread):
 
     def run(self) -> None:
         try:
-            if self.takes_ctx:
-                self.fn(self.msg, self.ctx)
-            else:
-                self.fn(self.msg)
+            # thread hop: the attempt span context becomes ambient, so every
+            # phase/backend/isocalc span in the callback nests under it
+            with tracing.attach(self.ctx.trace):
+                if self.takes_ctx:
+                    self.fn(self.msg, self.ctx)
+                else:
+                    self.fn(self.msg)
         except BaseException as exc:  # noqa: BLE001 — recorded, not swallowed
             self.error = exc
             self.tb = traceback.format_exc()
@@ -220,6 +230,7 @@ class JobScheduler:
         queue: str = QUEUE_ANNOTATE,
         metrics=None,
         admission=None,
+        trace_dir: str | Path | None = None,
     ):
         self.root = Path(queue_dir) / queue
         for s in _STATES:
@@ -227,6 +238,12 @@ class JobScheduler:
         self.callback = callback
         self._cb_takes_ctx = _callback_takes_ctx(callback)
         self.cfg = config or ServiceConfig()
+        # end-to-end tracing: per-job JSONL files land here (None disables
+        # the file sink; spans still reach the flight recorder)
+        self.trace_dir = str(trace_dir) if trace_dir else None
+        # live root trace contexts + their submit timestamps, by msg_id —
+        # the seam every terminal outcome closes the root "submit" span at
+        self._trace_roots: dict[str, tuple[tracing.TraceContext, float]] = {}
         self.retry = RetryPolicy.from_config(self.cfg)
         self.metrics = metrics
         # service-level admission controller (service/admission.py): the
@@ -311,6 +328,48 @@ class JobScheduler:
             self._terminal_count += 1
         if self.admission is not None:
             self.admission.note_terminal(rec.msg_id)
+
+    # -------------------------------------------------------------- tracing
+    def _trace_ctx(self, msg_id: str,
+                   msg: dict | None) -> tuple[tracing.TraceContext, float]:
+        """Root trace context + submit timestamp for a message.  The ids
+        come from ``service.trace`` (stamped at POST /submit), so a
+        restarted scheduler — or a later attempt — continues the SAME trace
+        and appends to the SAME file; messages published without one
+        (direct spool drops, the blocking daemon) get a root minted at
+        first claim."""
+        with self._records_lock:
+            hit = self._trace_roots.get(msg_id)
+        if hit is not None:
+            return hit
+        svc = msg.get("service", {}) if isinstance(msg, dict) else {}
+        t = svc.get("trace") if isinstance(svc, dict) else None
+        t = t if isinstance(t, dict) else {}
+        trace_id = str(t.get("trace_id") or tracing.new_id())
+        span_id = str(t.get("span") or tracing.new_id())
+        start = float(t.get("start") or
+                      (msg or {}).get("published_at") or time.time())
+        file = (str(tracing.trace_path(self.trace_dir, trace_id))
+                if self.trace_dir else "")
+        ctx = tracing.TraceContext(trace_id=trace_id, span_id=span_id,
+                                   job_id=msg_id, file=file)
+        with self._records_lock:
+            self._trace_roots[msg_id] = (ctx, start)
+        return ctx, start
+
+    def _close_trace(self, rec: JobRecord, state: str) -> None:
+        """Terminal outcome: close the root ``submit`` span (its duration is
+        submit → terminal, covering queueing + every attempt)."""
+        with self._records_lock:
+            hit = self._trace_roots.pop(rec.msg_id, None)
+        if hit is None:
+            return
+        ctx, start = hit
+        tracing.emit_span(
+            ctx, "submit", ts=start, dur=time.time() - start,
+            span_id=ctx.span_id, state=state, msg_id=rec.msg_id,
+            ds_id=rec.ds_id, attempts=rec.attempts,
+            **({"error": rec.error[:500]} if rec.error else {}))
 
     # ---------------------------------------------------------- dispatcher
     def _scan_pending(self, now: float) -> list[tuple[tuple, Path, dict]]:
@@ -399,6 +458,11 @@ class JobScheduler:
             rec.attempts = int(msg.get("service", {}).get("attempts", 0))
             rec.state = "claimed"
             rec.claimed_at = time.time()
+            ctx, _start = self._trace_ctx(msg_id, msg)
+            rec.trace_id = ctx.trace_id
+            tracing.event("claim", ctx=ctx, tenant=rec.tenant,
+                          attempts=rec.attempts,
+                          claims=int(msg.get("service", {}).get("claims", 0)))
             with self._records_lock:
                 self._inflight_by_tenant[rec.tenant] = (
                     self._inflight_by_tenant.get(rec.tenant, 0) + 1)
@@ -514,9 +578,13 @@ class JobScheduler:
             hb = ClaimHeartbeat(claimed, interval_s=self.cfg.heartbeat_interval_s)
             hb.start()
             token = CancelToken(deadline_at or None)
+            root, _start = self._trace_ctx(msg_id, msg)
+            rec.trace_id = root.trace_id
+            attempt_trace = root.child()
             ctx = JobContext(msg_id=msg_id, attempt=rec.attempts,
                              device_token=self.device_token,
-                             metrics=self.metrics, cancel=token)
+                             metrics=self.metrics, cancel=token,
+                             trace=attempt_trace)
             attempt = _Attempt(self.callback, msg, ctx, self._cb_takes_ctx)
             with self._records_lock:
                 self._live[msg_id] = (token, attempt)
@@ -543,6 +611,14 @@ class JobScheduler:
                 if abandoned and self.metrics:
                     self.m_abandoned.inc()
             dt = time.perf_counter() - t0
+            # the attempt span: its body ran in the _Attempt thread (where
+            # attempt_trace was ambient); the worker owns the measured
+            # duration and therefore the emission
+            tracing.emit_span(
+                root, "attempt", ts=rec.started_at, dur=dt,
+                span_id=attempt_trace.span_id, parent_id=root.span_id,
+                attempt=rec.attempts, timed_out=bool(timed_out),
+                abandoned=bool(abandoned))
             if self.metrics:
                 self.m_duration.observe(dt)
             if self.admission is not None:
@@ -594,10 +670,16 @@ class JobScheduler:
         """The single seam every cancellation (timeout, deadline, user,
         watchdog) passes through on its way to the attempt's token."""
         failpoint(FP_CANCEL_DELIVER)
-        if token.cancel(reason) and self.metrics:
-            kind = ("deadline" if reason.startswith("deadline") else
-                    "stalled" if reason.startswith("stalled") else
-                    "user" if "user" in reason else "timeout")
+        delivered = token.cancel(reason)
+        kind = ("deadline" if reason.startswith("deadline") else
+                "stalled" if reason.startswith("stalled") else
+                "user" if "user" in reason else "timeout")
+        if delivered:
+            with self._records_lock:
+                hit = self._trace_roots.get(rec.msg_id)
+            tracing.event("cancel", ctx=hit[0] if hit else None,
+                          reason=reason, kind=kind)
+        if delivered and self.metrics:
             if kind != "deadline":   # deadline counts once, at its terminal
                 self.m_cancels.labels(reason=kind).inc()
         rec.error = reason
@@ -657,6 +739,10 @@ class JobScheduler:
         rec.state = "cancelled"
         rec.error = reason
         rec.finished_at = time.time()
+        ctx, _start = self._trace_ctx(msg_id, msg)
+        rec.trace_id = ctx.trace_id
+        tracing.event("cancel", ctx=ctx, reason=reason, kind="user")
+        self._close_trace(rec, "cancelled")
         self._note_terminal(rec)
         if self.metrics:
             self.m_jobs.labels(state="cancelled").inc()
@@ -696,6 +782,7 @@ class JobScheduler:
         clear_heartbeat(claimed)
         rec.state = "done"
         rec.finished_at = time.time()
+        self._close_trace(rec, "done")
         self._note_terminal(rec)
         if self.metrics:
             self.m_jobs.labels(state="done").inc()
@@ -711,6 +798,11 @@ class JobScheduler:
         delay = self.retry.backoff_s(rec.attempts)
         rec.state = "retry_wait"
         rec.next_retry_at = time.time() + delay
+        with self._records_lock:
+            hit = self._trace_roots.get(rec.msg_id)
+        tracing.event("retry", ctx=hit[0] if hit else None,
+                      attempt=rec.attempts, max_attempts=max_attempts,
+                      delay_s=round(delay, 3), error=error[:500])
         if self.metrics:
             self.m_retries.inc()
             self.m_backoff.observe(delay)
@@ -750,6 +842,7 @@ class JobScheduler:
         rec.state = "failed"
         rec.error = error
         rec.finished_at = time.time()
+        self._close_trace(rec, "failed")
         self._note_terminal(rec)
         if self.metrics:
             self.m_jobs.labels(state="failed").inc()
@@ -774,6 +867,7 @@ class JobScheduler:
         rec.state = "cancelled"
         rec.error = error
         rec.finished_at = time.time()
+        self._close_trace(rec, "cancelled")
         self._note_terminal(rec)
         if self.metrics:
             self.m_jobs.labels(state="cancelled").inc()
@@ -785,6 +879,10 @@ class JobScheduler:
         already too late only wastes the device."""
         if self.metrics:
             self.m_cancels.labels(reason="deadline").inc()
+        with self._records_lock:
+            hit = self._trace_roots.get(rec.msg_id)
+        tracing.event("deadline", ctx=hit[0] if hit else None,
+                      deadline_at=rec.deadline_at, error=error[:500])
         self._dead_letter(claimed, msg if isinstance(msg, dict) else {},
                           rec, error, "")
 
@@ -810,6 +908,10 @@ class JobScheduler:
         rec.state = "quarantined"
         rec.error = reason
         rec.finished_at = time.time()
+        ctx, _start = self._trace_ctx(claimed.stem, msg)
+        rec.trace_id = ctx.trace_id
+        tracing.event("quarantine", ctx=ctx, claims=claims)
+        self._close_trace(rec, "quarantined")
         self._note_terminal(rec)
         if self.metrics:
             self.m_jobs.labels(state="quarantined").inc()

@@ -19,12 +19,13 @@ from pathlib import Path
 from ..engine.daemon import QUEUE_ANNOTATE, QueuePublisher, _STATES
 from ..models.breaker import attach_metrics as attach_breaker_metrics
 from ..models.breaker import get_device_breaker
+from ..utils import tracing
 from ..utils.config import SMConfig
 from ..utils.failpoints import attach_metrics as attach_failpoint_metrics
-from ..utils.logger import logger, set_phase_observer
+from ..utils.logger import add_phase_observer, logger, remove_phase_observer
 from .admission import AdmissionController
 from .api import AdminAPI
-from .metrics import MetricsRegistry
+from .metrics import MetricsRegistry, build_info_collector, process_collector
 from .scheduler import JobScheduler
 
 
@@ -44,6 +45,13 @@ class AnnotationService:
         self.queue = queue
         self.metrics = MetricsRegistry()
         self.publisher = QueuePublisher(queue_dir, queue=queue)
+        # end-to-end tracing (ISSUE 5, docs/OBSERVABILITY.md): per-job JSONL
+        # files + the flight-recorder ring behind /jobs/<id>/trace and
+        # /debug/events.  tracing.enabled=false keeps only the no-op stubs.
+        tracing.configure(enabled=self.sm_config.tracing.enabled,
+                          ring_size=self.sm_config.tracing.ring_size)
+        self.trace_dir = (self.sm_config.trace_dir
+                          if self.sm_config.tracing.enabled else None)
         # overload protection in front of /submit: bounded depth, per-tenant
         # quotas, EWMA latency shedding (service/admission.py); the
         # scheduler feeds terminal outcomes + attempt latency back into it
@@ -51,7 +59,7 @@ class AnnotationService:
         self.admission.sync_from_spool(self.queue_dir / queue)
         self.scheduler = JobScheduler(
             queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
-            admission=self.admission)
+            admission=self.admission, trace_dir=self.trace_dir)
         # device-backend circuit breaker: configure the process singleton
         # from THIS service's knobs and export its state on /metrics
         get_device_breaker(cfg)
@@ -75,6 +83,11 @@ class AnnotationService:
                        "Isotope patterns computed per second, over the "
                        "window since the previous scrape",
                        isocalc_mod.patterns_total)
+        # build identity + process health (ISSUE 5 satellite): dashboards
+        # need a version/backend join key and leak-spotting gauges (RSS,
+        # threads, FDs) the load sweep only catches in tests
+        build_info_collector(self.metrics, backend=self.sm_config.backend)
+        process_collector(self.metrics)
         if residency is not None:
             self.metrics.add_collector(self._collect_residency)
         self.api = AdminAPI(self, host=cfg.http_host,
@@ -110,7 +123,9 @@ class AnnotationService:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
-        set_phase_observer(self._observe_phase)
+        # additive registration (ISSUE 5 satellite): the old single-slot
+        # set_phase_observer silently evicted any other observer
+        add_phase_observer(self._observe_phase)
         self.scheduler.start()
         if self.api is not None:
             self.api.start()
@@ -125,7 +140,7 @@ class AnnotationService:
         ok = self.scheduler.shutdown(timeout_s)
         if self.api is not None:
             self.api.stop()
-        set_phase_observer(None)
+        remove_phase_observer(self._observe_phase)
         return ok
 
     def install_signal_handlers(self) -> None:

@@ -261,6 +261,28 @@ class ServiceConfig:
 
 
 @dataclass(frozen=True)
+class TracingConfig:
+    """End-to-end job tracing (utils/tracing.py, docs/OBSERVABILITY.md):
+    per-job JSONL span logs + the in-memory flight recorder behind
+    ``GET /jobs/<id>/trace`` and ``GET /debug/events``."""
+    enabled: bool = True                 # span/event emission on traced jobs
+    dir: str = ""                        # trace-file dir; "" = <work_dir>/traces
+    ring_size: int = 2048                # flight-recorder record capacity
+
+    def __post_init__(self):
+        if self.ring_size <= 0:
+            raise ValueError("tracing.ring_size must be positive")
+
+
+@dataclass(frozen=True)
+class LogsConfig:
+    """Structured logging: ``json: true`` switches every handler to one
+    JSON object per line with ``trace_id``/``job_id``/``span`` injected from
+    the ambient trace context (utils/logger.py::JsonLogFormatter)."""
+    json: bool = False
+
+
+@dataclass(frozen=True)
 class StorageConfig:
     """Replaces sm_config['db'/'elasticsearch'] service blocks: pluggable local
     sinks (parquet results + sqlite index) instead of Postgres/ES."""
@@ -278,8 +300,15 @@ class SMConfig:
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
     storage: StorageConfig = field(default_factory=StorageConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+    logs: LogsConfig = field(default_factory=LogsConfig)
     work_dir: str = "/tmp/sm_tpu_work"
     logs_dir: str = ""                   # "" = console only
+
+    @property
+    def trace_dir(self) -> str:
+        """Resolved per-job trace-file directory (tracing.dir wins)."""
+        return self.tracing.dir or str(Path(self.work_dir) / "traces")
     # fault injection for chaos/recovery testing (utils/failpoints.py,
     # docs/RECOVERY.md): same grammar as the SM_FAILPOINTS env var, which
     # always wins when set; "" disables.  NEVER set in production configs.
@@ -331,5 +360,7 @@ _DATACLASS_FIELDS = {
     ("SMConfig", "parallel"): ParallelConfig,
     ("SMConfig", "storage"): StorageConfig,
     ("SMConfig", "service"): ServiceConfig,
+    ("SMConfig", "tracing"): TracingConfig,
+    ("SMConfig", "logs"): LogsConfig,
     ("ServiceConfig", "admission"): AdmissionConfig,
 }

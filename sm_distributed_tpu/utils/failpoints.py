@@ -289,6 +289,12 @@ def failpoint(name: str, path: str | os.PathLike | None = None) -> None:
         f"FAILPOINT-FIRED name={name} action={spec.action} "
         f"hit={spec.hits} path={path or ''}\n")
     sys.stderr.flush()
+    # attach the trigger to the owning span (ISSUE 5) — emitted (and the
+    # trace line flushed) before the action runs, so even a crash action
+    # leaves its mark in the job's trace for the post-mortem
+    from . import tracing
+
+    tracing.event("failpoint", name=name, action=spec.action, hit=spec.hits)
     if spec.action == "raise":
         exc = _EXCEPTIONS[spec.arg or "FailpointError"]
         raise exc(f"injected failpoint {name} (hit {spec.hits})")

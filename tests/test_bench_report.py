@@ -32,7 +32,12 @@ def test_report_schema_and_values():
         "xla_cache_entries_before",
         "n_ions", "n_pixels", "pixels_per_s", "isocalc_s",
         "isocalc_cold_s", "isocalc_workers", "patterns_per_s",
+        "phases",
     }
+    # per-phase wall (ISSUE 5 satellite): the trajectory explains WHERE
+    # time moved; stream_s appears only when the case config is passed
+    assert out["phases"] == {"isocalc_s": 0.5, "floor_rep_s": 2.0,
+                             "compile_s": 12.0}
     assert out["value"] == 5000.0
     assert out["vs_baseline"] == 100.0
     assert out["jax_spread"] == 0.02
