@@ -326,11 +326,24 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
     jax_spread = (srt[-1] - srt[0]) / jax_rate
     logger.info("[%s] jax_tpu: median of 5 streams %.1f ions/s "
                 "(spread %.1f%%)", cfg.name, jax_rate, 100 * jax_spread)
+    # HBM pinning (ISSUE 6 satellite): the device high-water mark while
+    # this case's cube + scratch are resident.  peak_bytes_in_use is a
+    # process-lifetime monotone max, so later cases report max(their own,
+    # earlier cases') — still the honest answer to "did this run fit".
+    # None (-> JSON null) on platforms without memory stats (CPU).
+    from sm_distributed_tpu.utils.devicemem import hbm_summary
+
+    hbm = hbm_summary(force_import=True)
+    if hbm["hbm_peak_bytes"] is not None:
+        logger.info("[%s] HBM peak: %.1f MB on %s", cfg.name,
+                    hbm["hbm_peak_bytes"] / 2**20, hbm["device_kind"])
     return dict(jax_rate=jax_rate, compile_dt=compile_dt,
                 jax_spread=jax_spread, cache_entries=cache_entries,
                 warmup_retried=warmup_retried,
                 warmup_skipped=bool(
-                    getattr(backend, "last_warmup_skipped", False)))
+                    getattr(backend, "last_warmup_skipped", False)),
+                hbm_peak_bytes=hbm["hbm_peak_bytes"],
+                device_kind=hbm["device_kind"])
 
 
 def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
@@ -362,6 +375,10 @@ def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
         "compile_s": round(jaxr["compile_dt"], 2),
         "warmup_retried": bool(jaxr.get("warmup_retried", False)),
         "warmup_skipped": bool(jaxr.get("warmup_skipped", False)),
+        # ISSUE 6 pinned fields: device identity + HBM high-water mark
+        # (null when the platform exposes no memory stats)
+        "hbm_peak_bytes": jaxr.get("hbm_peak_bytes"),
+        "device_kind": jaxr.get("device_kind"),
         "xla_cache_entries_before": jaxr["cache_entries"],
         "n_ions": int(prep["table"].n_ions),
         "n_pixels": int(prep["ds"].n_pixels),

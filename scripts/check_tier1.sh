@@ -18,7 +18,9 @@
 # must produce byte-identical cache shards vs the serial run.  Then the
 # trace smoke gate (scripts/trace_smoke.py): a traced spheroid job through
 # the real service must emit a schema-valid, Perfetto-loadable trace that
-# trace_report.py renders.
+# trace_report.py renders.  Then the perf-sentinel self-check
+# (scripts/perf_sentinel.py): the committed BENCH_r*.json history must pass
+# against itself and a synthetic regression must trip the gate.
 #
 # Exit codes: 0 = all gates pass, 1 = regression / gate failure.
 # Note: pytest's own exit code is nonzero while the 32 pre-existing
@@ -60,6 +62,14 @@ fi
 # Perfetto-loadable trace that scripts/trace_report.py renders
 if ! env JAX_PLATFORMS=cpu python scripts/trace_smoke.py; then
     echo "check_tier1: FAIL — trace smoke gate failed" >&2
+    exit 1
+fi
+
+# perf-sentinel self-check (ISSUE 6): the regression gate itself is gated —
+# the newest committed BENCH_r*.json must pass against its own history AND
+# a synthetically degraded copy must trip the sentinel
+if ! env JAX_PLATFORMS=cpu python scripts/perf_sentinel.py --self-check; then
+    echo "check_tier1: FAIL — perf sentinel self-check failed" >&2
     exit 1
 fi
 

@@ -20,7 +20,7 @@ from pathlib import Path
 
 from ..io.dataset import SpectralDataset
 from ..models.msm_basic import IsotopePrefetch, MSMBasicSearch, SearchResultsBundle
-from ..utils import tracing
+from ..utils import devicemem, tracing
 from ..utils.cancel import JobCancelledError, hold_cancellable
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger, phase_timer
@@ -71,6 +71,10 @@ class SearchJob:
         # generation stats of the last completed run (workers, patterns/s,
         # device flag) — read by probes/benches (scripts/cold_path_bench.py)
         self.last_isocalc_stats: dict = {}
+        # device-memory high-water mark of the last completed run (ISSUE 6):
+        # {device_kind, hbm_peak_bytes, ...}; byte fields None on platforms
+        # without memory stats (utils/devicemem.py)
+        self.last_hbm: dict = {}
         self.store = SearchResultsStore(
             self.ledger,
             store_images=self.sm_config.storage.store_images,
@@ -186,6 +190,12 @@ class SearchJob:
                     if self.sm_config.storage.store_images:
                         self._store_annotation_images(ds, search, bundle)
                     self.store.store(self.ds_id, job_id, bundle, ion_mzs)
+                # pin the device high-water mark while this job's arrays
+                # are still resident; the trace gets it as an event so
+                # every per-phase hbm sample has a job-level roll-up
+                self.last_hbm = devicemem.hbm_summary()
+                if self.last_hbm.get("hbm_peak_bytes") is not None:
+                    tracing.event("hbm_job_peak", **self.last_hbm)
             self.ledger.finish_job(job_id)
             if search.last_checkpoint is not None:
                 # only after results are durably persisted: a storage failure

@@ -48,6 +48,34 @@ FP_DEVICE_ERROR = register_failpoint(
     "circuit breaker counts (open -> degrade to numpy -> half-open probe)")
 
 
+# First-annotation observers (ISSUE 6): called once per search when the
+# first checkpoint group's metrics land — the earliest moment FDR-rankable
+# results exist.  Same producer-side pattern as logger phase observers /
+# isocalc attach_metrics: the service's SLOTracker subscribes without this
+# module importing the service layer.
+_first_annotation_observers: list = []
+
+
+def add_first_annotation_observer(fn) -> None:
+    if fn not in _first_annotation_observers:
+        _first_annotation_observers.append(fn)
+
+
+def remove_first_annotation_observer(fn) -> None:
+    if fn in _first_annotation_observers:
+        _first_annotation_observers.remove(fn)
+
+
+def _notify_first_annotation() -> None:
+    """Exception-safe dispatch (observability never fails the pipeline)."""
+    for fn in list(_first_annotation_observers):
+        try:
+            fn()
+        except Exception:
+            logger.warning("first-annotation observer %r failed", fn,
+                           exc_info=True)
+
+
 def _slice_table(table: IsotopePatternTable, s: int, e: int) -> IsotopePatternTable:
     return IsotopePatternTable(
         sfs=table.sfs[s:e],
@@ -669,6 +697,7 @@ class MSMBasicSearch:
                 # needs a wider band (models/msm_jax.py::presize)
                 backend.presize(
                     _slice_table(table, s, e) for s, e in slices)
+            first_scored = False
             for gi, group in enumerate(groups):
                 if gi < done:
                     continue
@@ -697,6 +726,12 @@ class MSMBasicSearch:
                     backend, degraded = self._score_group(
                         backend, table, metrics, group, breaker, use_device,
                         degraded)
+                if not first_scored:
+                    # the first FDR-rankable metrics of this search exist
+                    # now — the submit→first-annotation SLI's stop clock
+                    first_scored = True
+                    tracing.event("first_annotation", group=gi)
+                    _notify_first_annotation()
                 if ckpt is not None:
                     with tracing.span("checkpoint_save", group=gi):
                         ckpt.save(metrics, gi, len(groups), row_ranges)
@@ -706,6 +741,10 @@ class MSMBasicSearch:
             # leftover checkpoint is harmless (fingerprint-guarded) and makes
             # an identical re-search skip scoring entirely.
             self.last_checkpoint = ckpt
+            if not first_scored:
+                # fully resumed from checkpoint (or an empty table): the
+                # first annotations were available immediately
+                _notify_first_annotation()
             if overlap:
                 # join generation (shard commits/compaction may trail the
                 # last row) and surface any late stream error before FDR

@@ -347,6 +347,18 @@ def fetch_scored_batches(pending) -> list[np.ndarray]:
             lambda p: to_numpy_global(p[0])[:p[1]].astype(np.float64), pending))
 
 
+# Warmup persistent-cache outcomes (ISSUE 6): "hit" = the warmup manifest
+# proved the cache already held every executable kind (executions skipped),
+# "miss" = representative batches actually ran (compile or cache-load).
+# Module-level plain ints (GIL-atomic increments); the service telemetry
+# collector pulls them lazily — this module stays service-agnostic.
+_WARMUP_CACHE_EVENTS = {"hit": 0, "miss": 0}
+
+
+def warmup_cache_events() -> dict:
+    return dict(_WARMUP_CACHE_EVENTS)
+
+
 class JaxBackend:
     """Fused-graph scorer selected by ``SMConfig.backend == 'jax_tpu'``."""
 
@@ -824,10 +836,12 @@ class JaxBackend:
         manifest_key = self._warmup_manifest_key(sorted(seen))
         if self._warmup_manifest_hit(manifest_key):
             self.last_warmup_skipped = True
+            _WARMUP_CACHE_EVENTS["hit"] += 1
             logger.info(
                 "warmup skipped: persistent cache manifest covers all %d "
                 "executable kinds", len(seen))
             return
+        _WARMUP_CACHE_EVENTS["miss"] += 1
         fetch_scored_batches([self._dispatch(t, plan) for t, plan in reps])
         self._write_warmup_manifest(manifest_key)
 

@@ -18,6 +18,11 @@ shape the ROADMAP north star asks for on top of the same spool contract:
   structured 429/503 + ``Retry-After`` instead of an unbounded backlog;
 - ``metrics``    — counters/gauges/histograms with Prometheus text
   exposition, threaded through ``phase_timer`` and ``DatasetResidency``;
+- ``telemetry``  — device/HBM monitor + SLO tracker: per-device HBM
+  gauges, device-token occupancy, XLA persistent-cache size/hit-miss,
+  a bounded metric-snapshot ring (``GET /debug/timeseries``), and
+  queue-wait / first-annotation / e2e SLO histograms with attainment
+  and error-budget burn served by ``GET /slo``;
 - ``api``        — stdlib ``http.server`` admin API (``/healthz``,
   ``/metrics``, ``/jobs``, ``POST /submit``, ``DELETE /jobs/<id>``);
 - ``server``     — ``AnnotationService`` composing all of the above (plus
@@ -35,15 +40,18 @@ from .admission import AdmissionController
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .scheduler import JobRecord, JobScheduler, RetryPolicy
 from .server import AnnotationService
+from .telemetry import DeviceMonitor, SLOTracker
 
 __all__ = [
     "AdmissionController",
     "AnnotationService",
     "Counter",
+    "DeviceMonitor",
     "Gauge",
     "Histogram",
     "JobRecord",
     "JobScheduler",
     "MetricsRegistry",
     "RetryPolicy",
+    "SLOTracker",
 ]

@@ -28,7 +28,13 @@ RabbitMQ's management UI):
   isocalc workers → store_results.  ``?raw=1`` returns the raw records;
 - ``GET /debug/events?n=``  the most recent N flight-recorder records
   (default 256) — every span/event from every job plus traceless service
-  events (admission sheds, breaker flips).
+  events (admission sheds, breaker flips);
+- ``GET /slo``  objective / attainment / error-budget burn per latency SLI
+  (queue-wait, submit→first-annotation, end-to-end), computed from the
+  live histograms (``service/telemetry.py``);
+- ``GET /debug/timeseries?n=``  the telemetry monitor's bounded ring of
+  periodic metric snapshots (per-device HBM, device-token occupancy,
+  queue depths, XLA cache size, RSS).
 
 ``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
 handler is read-only except ``/submit`` (appends to ``pending/``) and
@@ -135,6 +141,15 @@ class AdminAPI:
                         n = int(q.get("n", ["256"])[0] or 256)
                         self._reply_json(
                             200, tracing.flight_recorder.recent(n))
+                    elif url.path == "/debug/timeseries":
+                        q = parse_qs(url.query)
+                        n = q.get("n", [None])[0]
+                        status, body = api._timeseries(
+                            int(n) if n else None)
+                        self._reply_json(status, body)
+                    elif url.path == "/slo":
+                        status, body = api._slo()
+                        self._reply_json(status, body)
                     elif (parts := url.path.strip("/").split("/"))[0] == \
                             "jobs" and len(parts) == 3 and parts[2] == "trace":
                         q = parse_qs(url.query)
@@ -309,6 +324,32 @@ class AdminAPI:
             return 200, {"trace_id": trace_id, "msg_id": msg_id,
                          "records": records}
         return 200, tracing.to_chrome_trace(records)
+
+    def _timeseries(self, n: int | None) -> tuple[int, dict]:
+        """``GET /debug/timeseries?n=`` — the telemetry monitor's snapshot
+        ring (device HBM, token occupancy, queue depths, cache size, RSS);
+        newest last."""
+        mon = getattr(self.service, "telemetry", None)
+        if mon is None:
+            return 404, {"error": "telemetry monitor not configured",
+                         "reason": "not_found"}
+        samples = mon.timeseries(n)
+        return 200, {
+            "interval_s": mon.cfg.sample_interval_s,
+            "capacity": mon.cfg.timeseries_len,
+            "enabled": bool(self.service.sm_config.telemetry.enabled),
+            "n": len(samples),
+            "samples": samples,
+        }
+
+    def _slo(self) -> tuple[int, dict]:
+        """``GET /slo`` — objective / attainment / error-budget burn per
+        SLI, computed from the live histograms (service/telemetry.py)."""
+        slo = getattr(self.service, "slo", None)
+        if slo is None:
+            return 404, {"error": "SLO tracker not configured",
+                         "reason": "not_found"}
+        return 200, slo.report()
 
     def _cancel(self, msg_id: str) -> tuple[int, dict]:
         disposition = self.service.scheduler.cancel(msg_id)

@@ -180,6 +180,34 @@ class _HistogramChild:
             self.sum += value
             self.count += 1
 
+    def snapshot(self) -> tuple[list[int], float, int]:
+        """Consistent (counts, sum, count) under the child lock — a scrape
+        racing ``observe`` must never see counts updated but count not
+        (that renders a +Inf bucket SMALLER than a finite one)."""
+        with self._lock:
+            return list(self.counts), self.sum, self.count
+
+    def fraction_below(self, threshold: float) -> tuple[float, int]:
+        """(fraction of observations <= threshold, total count) — the SLO
+        attainment primitive.  Exact at bucket boundaries; inside a bucket
+        the fraction interpolates linearly (observations beyond the last
+        finite bucket count only toward the denominator)."""
+        counts, _sum, total = self.snapshot()
+        if total == 0:
+            return 0.0, 0
+        below = 0.0
+        lo = 0.0
+        for le, n in zip(self.buckets, counts):
+            if threshold >= le:
+                below += n
+            elif threshold > lo:
+                below += n * (threshold - lo) / (le - lo)
+                break
+            else:
+                break
+            lo = le
+        return min(1.0, below / total), total
+
 
 class Histogram(_Metric):
     kind = "histogram"
@@ -198,16 +226,28 @@ class Histogram(_Metric):
         lines = []
         for key, c in sorted(self._children.items()):
             base = self._label_dict(key)
+            counts, total_sum, count = c.snapshot()
             cum = 0
-            for le, n in zip(c.buckets, c.counts):
+            for le, n in zip(c.buckets, counts):
                 cum += n
                 lines.append(
                     f"{self.name}_bucket{_fmt_labels({**base, 'le': _fmt_value(le)})} {cum}")
             lines.append(
-                f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} {c.count}")
-            lines.append(f"{self.name}_sum{_fmt_labels(base)} {_fmt_value(c.sum)}")
-            lines.append(f"{self.name}_count{_fmt_labels(base)} {c.count}")
+                f"{self.name}_bucket{_fmt_labels({**base, 'le': '+Inf'})} {count}")
+            lines.append(f"{self.name}_sum{_fmt_labels(base)} {_fmt_value(total_sum)}")
+            lines.append(f"{self.name}_count{_fmt_labels(base)} {count}")
         return lines
+
+    def fraction_below(self, threshold: float) -> tuple[float, int]:
+        """Aggregate ``fraction_below`` across all children (SLO helper)."""
+        with self._lock:
+            children = list(self._children.values())
+        below = total = 0
+        for c in children:
+            f, n = c.fraction_below(threshold)
+            below += f * n
+            total += n
+        return (below / total if total else 0.0), total
 
 
 def rate_collector(registry: "MetricsRegistry", name: str, help: str,
@@ -310,6 +350,13 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list = []
+        # exception-safe collector dispatch (ISSUE 6 satellite): one broken
+        # callback must not break the scrape OR starve the collectors after
+        # it, and the failure count itself is a scrapable signal
+        self._collect_errors = self.counter(
+            "sm_metrics_collect_errors_total",
+            "Collect callbacks that raised during a /metrics scrape",
+            ("collector",))
 
     def _register(self, metric: _Metric) -> _Metric:
         with self._lock:
@@ -346,6 +393,9 @@ class MetricsRegistry:
             except Exception:  # a broken collector must not kill /metrics
                 from ..utils.logger import logger
 
+                name = getattr(fn, "__qualname__",
+                               getattr(fn, "__name__", repr(fn)))
+                self._collect_errors.labels(collector=str(name)[:80]).inc()
                 logger.warning("metrics collector %r failed", fn, exc_info=True)
         with self._lock:
             metrics = [self._metrics[k] for k in sorted(self._metrics)]

@@ -231,6 +231,7 @@ class JobScheduler:
         metrics=None,
         admission=None,
         trace_dir: str | Path | None = None,
+        slo=None,
     ):
         self.root = Path(queue_dir) / queue
         for s in _STATES:
@@ -249,6 +250,9 @@ class JobScheduler:
         # service-level admission controller (service/admission.py): the
         # scheduler reports terminal outcomes + attempt latency into it
         self.admission = admission
+        # SLO tracker (service/telemetry.py): queue-wait observed at each
+        # job's first attempt start, e2e latency at every terminal outcome
+        self.slo = slo
         # ONE token: device-bound phases of concurrent jobs serialize here
         self.device_token = threading.Lock()
         self._records: dict[str, JobRecord] = {}
@@ -365,6 +369,8 @@ class JobScheduler:
         if hit is None:
             return
         ctx, start = hit
+        if self.slo is not None:
+            self.slo.observe_terminal(rec.msg_id, state, start)
         tracing.emit_span(
             ctx, "submit", ts=start, dur=time.time() - start,
             span_id=ctx.span_id, state=state, msg_id=rec.msg_id,
@@ -580,6 +586,11 @@ class JobScheduler:
             token = CancelToken(deadline_at or None)
             root, _start = self._trace_ctx(msg_id, msg)
             rec.trace_id = root.trace_id
+            if self.slo is not None:
+                # _start is the submit timestamp (service.trace.start /
+                # published_at), so queue wait covers the whole spool dwell
+                self.slo.job_started(msg_id, _start, rec.started_at,
+                                     rec.attempts)
             attempt_trace = root.child()
             ctx = JobContext(msg_id=msg_id, attempt=rec.attempts,
                              device_token=self.device_token,

@@ -27,6 +27,7 @@ from .admission import AdmissionController
 from .api import AdminAPI
 from .metrics import MetricsRegistry, build_info_collector, process_collector
 from .scheduler import JobScheduler
+from .telemetry import DeviceMonitor, SLOTracker
 
 
 class AnnotationService:
@@ -57,9 +58,22 @@ class AnnotationService:
         # scheduler feeds terminal outcomes + attempt latency back into it
         self.admission = AdmissionController(cfg.admission, metrics=self.metrics)
         self.admission.sync_from_spool(self.queue_dir / queue)
+        # SLO instrumentation (service/telemetry.py): queue-wait / first-
+        # annotation / e2e histograms recorded at the scheduler's seams,
+        # attainment served by GET /slo
+        self.slo = SLOTracker(self.metrics, self.sm_config.telemetry)
         self.scheduler = JobScheduler(
             queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
-            admission=self.admission, trace_dir=self.trace_dir)
+            admission=self.admission, trace_dir=self.trace_dir, slo=self.slo)
+        # device & memory telemetry: HBM/occupancy/cache sampler feeding
+        # gauges + the GET /debug/timeseries snapshot ring
+        from ..parallel.distributed import compile_cache_path
+
+        self.telemetry = DeviceMonitor(
+            self.metrics, self.sm_config.telemetry,
+            device_token=self.scheduler.device_token,
+            queue_root=self.queue_dir / queue,
+            compile_cache_dir=compile_cache_path(self.sm_config))
         # device-backend circuit breaker: configure the process singleton
         # from THIS service's knobs and export its state on /metrics
         get_device_breaker(cfg)
@@ -126,6 +140,14 @@ class AnnotationService:
         # additive registration (ISSUE 5 satellite): the old single-slot
         # set_phase_observer silently evicted any other observer
         add_phase_observer(self._observe_phase)
+        # first-annotation SLI: msm_basic notifies once per search when the
+        # first checkpoint group's metrics land (producer-side observer
+        # list, same pattern as phase observers)
+        from ..models.msm_basic import add_first_annotation_observer
+
+        add_first_annotation_observer(self.slo.note_first_annotation)
+        if self.sm_config.telemetry.enabled:
+            self.telemetry.start()
         self.scheduler.start()
         if self.api is not None:
             self.api.start()
@@ -140,6 +162,10 @@ class AnnotationService:
         ok = self.scheduler.shutdown(timeout_s)
         if self.api is not None:
             self.api.stop()
+        self.telemetry.stop()
+        from ..models.msm_basic import remove_first_annotation_observer
+
+        remove_first_annotation_observer(self.slo.note_first_annotation)
         remove_phase_observer(self._observe_phase)
         return ok
 

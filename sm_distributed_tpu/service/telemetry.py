@@ -1,0 +1,339 @@
+"""Quantitative telemetry: device/HBM monitor, SLO tracker, snapshot ring.
+
+ISSUE 6 tentpole.  PR 5 gave every job a *trace* (causality); this module
+adds the *quantities* the ROADMAP's scale-out items need eyes on:
+
+- **DeviceMonitor** — a sampling thread reading per-device HBM
+  bytes-in-use / peak (``utils/devicemem.py``; ``None``-safe on CPU), the
+  scheduler's device-token occupancy (fraction of recent samples that
+  found the TPU token held — the single-token serialization bottleneck
+  item 1 replaces), XLA persistent-cache size, and process RSS.  Every
+  sample updates gauges on the shared ``MetricsRegistry`` AND lands in a
+  bounded in-memory **time-series ring** served by ``GET
+  /debug/timeseries`` — a scrape-free flight recorder for quantities, the
+  same idea ``GET /debug/events`` is for spans.  The monitor also installs
+  a ``phase_timer`` observer so every traced job phase records its peak
+  HBM (gauge ``sm_phase_hbm_peak_bytes{phase=}`` + an ``hbm`` trace
+  event) without the engine importing the service layer.
+
+- **SLOTracker** — first-class SLO instrumentation: histograms for
+  queue-wait (submit → first attempt start), submit → first annotation
+  (the first scored checkpoint group; ``models/msm_basic.py`` notifies
+  through a module-level observer list, same pattern as phase observers),
+  and end-to-end latency (submit → terminal outcome), recorded at the
+  scheduler's seams.  ``report()`` computes attainment against the
+  configured objectives straight from the histogram buckets
+  (``Histogram.fraction_below``) plus the error-budget burn rate —
+  ``GET /slo``.
+
+Config: ``SMConfig.telemetry`` (enabled, sample_interval_s,
+timeseries_len, slo_* objectives).  Docs: docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from collections import deque
+from pathlib import Path
+
+from ..utils import devicemem, tracing
+from ..utils.config import TelemetryConfig
+from ..utils.logger import add_phase_observer, logger, remove_phase_observer
+
+# occupancy is the mean of the most recent N token samples — at the default
+# 5 s cadence this is a ~5 min sliding window, long enough to smooth one
+# job's hold/release flapping, short enough to show a saturation trend
+_OCCUPANCY_WINDOW = 60
+
+
+class DeviceMonitor:
+    """Sample device/HBM/cache/occupancy state into gauges + a ring."""
+
+    def __init__(self, registry, cfg: TelemetryConfig | None = None,
+                 device_token=None, queue_root: str | Path | None = None,
+                 compile_cache_dir: str | Path | None = None):
+        self.registry = registry
+        self.cfg = cfg or TelemetryConfig()
+        # the scheduler's TPU token (threading.Lock): sampled, never taken
+        self.device_token = device_token
+        self.queue_root = Path(queue_root) if queue_root else None
+        self.compile_cache_dir = (Path(compile_cache_dir)
+                                  if compile_cache_dir else None)
+        self._ring: deque = deque(maxlen=self.cfg.timeseries_len)
+        self._occ: deque = deque(maxlen=_OCCUPANCY_WINDOW)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._prev_cache_entries: int | None = None
+        m = registry
+        self.g_hbm_in_use = m.gauge(
+            "sm_device_hbm_bytes_in_use",
+            "HBM bytes currently allocated, per device", ("device",))
+        self.g_hbm_peak = m.gauge(
+            "sm_device_hbm_peak_bytes",
+            "Peak HBM bytes allocated since process start, per device",
+            ("device",))
+        self.g_hbm_limit = m.gauge(
+            "sm_device_hbm_limit_bytes",
+            "HBM capacity available to the allocator, per device",
+            ("device",))
+        self.g_devices = m.gauge(
+            "sm_device_count", "Local accelerator devices visible to jax")
+        self.g_occupancy = m.gauge(
+            "sm_device_token_occupancy_ratio",
+            "Fraction of recent samples that found the device token held")
+        self.g_phase_hbm = m.gauge(
+            "sm_phase_hbm_peak_bytes",
+            "Peak HBM observed at each pipeline phase's exit", ("phase",))
+        self.g_cache_entries = m.gauge(
+            "sm_xla_cache_entries",
+            "Executable entries in the persistent XLA compile cache")
+        self.g_cache_bytes = m.gauge(
+            "sm_xla_cache_bytes",
+            "Total size of the persistent XLA compile cache")
+        self.c_cache_miss = m.counter(
+            "sm_xla_cache_misses_total",
+            "Cold compiles observed as new persistent-cache entries")
+        self.c_warmup_cache = m.counter(
+            "sm_xla_cache_warmup_total",
+            "Backend warmups by persistent-cache outcome (hit = manifest "
+            "proved warm, executions skipped)", ("result",))
+
+    # ------------------------------------------------------------- sampling
+    def _cache_stats(self) -> tuple[int | None, int | None]:
+        """(entry count, total bytes) of the persistent XLA cache, or
+        (None, None) when no cache dir is configured/present.  Counts only
+        real ``jit_*`` executable entries (the bench.py rule), so growth
+        strictly implies cold compiles."""
+        d = self.compile_cache_dir
+        if d is None or not d.is_dir():
+            return None, None
+        import re
+
+        entry_re = re.compile(r"^jit_.+-[0-9a-f]{32,}(-cache)?$")
+        n = size = 0
+        try:
+            for p in d.iterdir():
+                if p.is_file() and entry_re.match(p.name):
+                    n += 1
+                    size += p.stat().st_size
+        except OSError:
+            return None, None
+        return n, size
+
+    def sample(self) -> dict:
+        """Take one snapshot: update every gauge and append to the ring."""
+        now = time.time()
+        devices = devicemem.device_stats()
+        hbm_in_use = hbm_peak = None
+        for d in devices:
+            label = f"{d['id']}:{d['kind']}"
+            if d["bytes_in_use"] is not None:
+                self.g_hbm_in_use.labels(device=label).set(d["bytes_in_use"])
+                hbm_in_use = (hbm_in_use or 0) + d["bytes_in_use"]
+            if d["peak_bytes"] is not None:
+                self.g_hbm_peak.labels(device=label).set(d["peak_bytes"])
+                hbm_peak = max(hbm_peak or 0, d["peak_bytes"])
+            if d["limit_bytes"] is not None:
+                self.g_hbm_limit.labels(device=label).set(d["limit_bytes"])
+        self.g_devices.set(len(devices))
+
+        locked = None
+        if self.device_token is not None:
+            locked = bool(self.device_token.locked())
+            self._occ.append(1.0 if locked else 0.0)
+            occupancy = sum(self._occ) / len(self._occ)
+            self.g_occupancy.set(occupancy)
+        else:
+            occupancy = None
+
+        entries, cache_bytes = self._cache_stats()
+        if entries is not None:
+            self.g_cache_entries.set(entries)
+            self.g_cache_bytes.set(cache_bytes or 0)
+            if self._prev_cache_entries is not None and \
+                    entries > self._prev_cache_entries:
+                self.c_cache_miss.inc(entries - self._prev_cache_entries)
+            self._prev_cache_entries = entries
+        self._collect_warmup_events()
+
+        snap = {
+            "ts": round(now, 3),
+            "devices": len(devices),
+            "device_kind": devices[0]["kind"] if devices else None,
+            "hbm_bytes_in_use": hbm_in_use,
+            "hbm_peak_bytes": hbm_peak,
+            "device_token_locked": locked,
+            "device_token_occupancy": (round(occupancy, 4)
+                                       if occupancy is not None else None),
+            "xla_cache_entries": entries,
+            "xla_cache_bytes": cache_bytes,
+            "rss_bytes": _rss_bytes(),
+        }
+        if self.queue_root is not None:
+            try:
+                snap["queue_pending"] = len(
+                    list(self.queue_root.glob("pending/*.json")))
+                snap["queue_running"] = len(
+                    list(self.queue_root.glob("running/*.json")))
+            except OSError:
+                pass
+        with self._lock:
+            self._ring.append(snap)
+        return snap
+
+    def _collect_warmup_events(self) -> None:
+        """Pull warmup cache hit/miss counts from the jax backend module —
+        lazily, ONLY if it was ever imported (a CPU-only service never pays
+        for it).  Counters move by delta, same as the residency collector."""
+        mod = sys.modules.get("sm_distributed_tpu.models.msm_jax")
+        if mod is None or not hasattr(mod, "warmup_cache_events"):
+            return
+        for result, count in mod.warmup_cache_events().items():
+            child = self.c_warmup_cache.labels(result=result)
+            child.inc(max(0.0, count - child.value))
+
+    def timeseries(self, n: int | None = None) -> list[dict]:
+        with self._lock:
+            items = list(self._ring)
+        return items if n is None else items[-max(0, int(n)):]
+
+    # ------------------------------------------------------ phase HBM hook
+    def _observe_phase(self, phase: str, seconds: float) -> None:
+        """phase_timer observer: record peak HBM at every phase exit (gauge
+        + an ``hbm`` event on the job's ambient trace).  No-op on platforms
+        without memory stats."""
+        peak = devicemem.hbm_peak_bytes()
+        if peak is None:
+            return
+        self.g_phase_hbm.labels(phase=phase).set(peak)
+        tracing.event("hbm", phase=phase, peak_bytes=peak)
+
+    # ------------------------------------------------------------ lifecycle
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.sample_interval_s):
+            try:
+                self.sample()
+            except Exception:  # telemetry must never kill the service
+                logger.warning("telemetry sample failed", exc_info=True)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        add_phase_observer(self._observe_phase)
+        self.sample()                     # the ring is never empty once up
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="telemetry-monitor")
+        self._thread.start()
+
+    def stop(self) -> None:
+        remove_phase_observer(self._observe_phase)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def _rss_bytes() -> int | None:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+# ------------------------------------------------------------------- SLOs
+class SLOTracker:
+    """Latency SLIs as histograms + attainment/error-budget reporting.
+
+    Three objectives (``SMConfig.telemetry.slo_*``), each "fraction of jobs
+    under T seconds >= target".  The scheduler records queue-wait at each
+    job's FIRST attempt start and end-to-end latency at every terminal
+    outcome; ``models/msm_basic.py`` notifies the first scored checkpoint
+    group through its first-annotation observer list (the moment the first
+    FDR-rankable metrics exist — the ROADMAP item 3 time-to-first-result
+    measure).  Attainment comes from the histogram buckets themselves, so
+    ``/slo`` and ``/metrics`` can never disagree.
+    """
+
+    def __init__(self, registry, cfg: TelemetryConfig | None = None):
+        self.cfg = cfg or TelemetryConfig()
+        self.h_queue_wait = registry.histogram(
+            "sm_slo_queue_wait_seconds",
+            "Submit -> first attempt start, per job")
+        self.h_first_annotation = registry.histogram(
+            "sm_slo_first_annotation_seconds",
+            "Submit -> first scored checkpoint group, per job")
+        self.h_e2e = registry.histogram(
+            "sm_slo_e2e_seconds",
+            "Submit -> terminal outcome, per job (all outcomes)")
+        self._lock = threading.Lock()
+        self._submits: dict[str, float] = {}     # job_id -> submit epoch
+        self._first_noted: set[str] = set()
+
+    # ------------------------------------------------------ recording seams
+    def job_started(self, job_id: str, submit_ts: float,
+                    attempt_start: float, attempt: int) -> None:
+        """Scheduler seam: an attempt is starting.  Queue wait is observed
+        once per job (first attempt only — retries are failure latency and
+        belong to e2e, not to admission)."""
+        with self._lock:
+            self._submits[job_id] = submit_ts
+        if attempt == 1:
+            self.h_queue_wait.observe(max(0.0, attempt_start - submit_ts))
+
+    def note_first_annotation(self, job_id: str = "") -> None:
+        """msm_basic observer: the first checkpoint group finished scoring.
+        ``job_id`` defaults to the ambient trace context's (the scoring
+        thread runs under the attempt span).  Unknown jobs (offline CLI
+        runs never registered by a scheduler) are ignored."""
+        if not job_id:
+            ctx = tracing.current()
+            job_id = ctx.job_id if ctx is not None else ""
+        if not job_id:
+            return
+        with self._lock:
+            submit_ts = self._submits.get(job_id)
+            if submit_ts is None or job_id in self._first_noted:
+                return
+            self._first_noted.add(job_id)
+        self.h_first_annotation.observe(max(0.0, time.time() - submit_ts))
+
+    def observe_terminal(self, job_id: str, state: str,
+                         submit_ts: float) -> None:
+        """Scheduler seam: terminal outcome — close out the job."""
+        self.h_e2e.observe(max(0.0, time.time() - submit_ts))
+        with self._lock:
+            self._submits.pop(job_id, None)
+            self._first_noted.discard(job_id)
+
+    # -------------------------------------------------------------- report
+    def report(self) -> dict:
+        """The ``GET /slo`` body: per-SLI objective, attainment computed
+        from the live histogram, and error-budget burn (attained shortfall
+        over the allowed shortfall; >= 1.0 means the budget is exhausted
+        at the current rate)."""
+        target = self.cfg.slo_target
+        out = {"target": target, "slos": {}}
+        for name, hist, objective_s in (
+                ("queue_wait", self.h_queue_wait, self.cfg.slo_queue_wait_s),
+                ("first_annotation", self.h_first_annotation,
+                 self.cfg.slo_first_annotation_s),
+                ("e2e", self.h_e2e, self.cfg.slo_e2e_s)):
+            attained, count = hist.fraction_below(objective_s)
+            entry = {
+                "objective_s": objective_s,
+                "target": target,
+                "count": count,
+                "attainment": round(attained, 6) if count else None,
+                "violations": (round((1.0 - attained) * count)
+                               if count else 0),
+                "error_budget_burn": (
+                    round((1.0 - attained) / (1.0 - target), 4)
+                    if count else None),
+            }
+            out["slos"][name] = entry
+        return out

@@ -261,6 +261,33 @@ class ServiceConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Quantitative telemetry (service/telemetry.py, docs/OBSERVABILITY.md):
+    the device/HBM monitor + metric-snapshot time-series ring behind
+    ``GET /debug/timeseries``, and the SLO objectives ``GET /slo`` reports
+    attainment/error-budget burn against."""
+    enabled: bool = True                 # start the sampling thread
+    sample_interval_s: float = 5.0       # device/occupancy sample cadence
+    timeseries_len: int = 720            # snapshot ring capacity (1 h @ 5 s)
+    # SLO objectives: latency threshold (seconds) + attainment target
+    # (fraction of jobs that must land under the threshold)
+    slo_queue_wait_s: float = 30.0       # submit -> first attempt start
+    slo_first_annotation_s: float = 120.0  # submit -> first scored group
+    slo_e2e_s: float = 600.0             # submit -> terminal outcome
+    slo_target: float = 0.99
+
+    def __post_init__(self):
+        if self.sample_interval_s <= 0 or self.timeseries_len <= 0:
+            raise ValueError(
+                "telemetry: sample_interval_s/timeseries_len must be positive")
+        if min(self.slo_queue_wait_s, self.slo_first_annotation_s,
+               self.slo_e2e_s) <= 0:
+            raise ValueError("telemetry: SLO thresholds must be positive")
+        if not 0.0 < self.slo_target < 1.0:
+            raise ValueError("telemetry: slo_target must be in (0, 1)")
+
+
+@dataclass(frozen=True)
 class TracingConfig:
     """End-to-end job tracing (utils/tracing.py, docs/OBSERVABILITY.md):
     per-job JSONL span logs + the in-memory flight recorder behind
@@ -301,6 +328,7 @@ class SMConfig:
     storage: StorageConfig = field(default_factory=StorageConfig)
     service: ServiceConfig = field(default_factory=ServiceConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     logs: LogsConfig = field(default_factory=LogsConfig)
     work_dir: str = "/tmp/sm_tpu_work"
     logs_dir: str = ""                   # "" = console only
@@ -361,6 +389,7 @@ _DATACLASS_FIELDS = {
     ("SMConfig", "storage"): StorageConfig,
     ("SMConfig", "service"): ServiceConfig,
     ("SMConfig", "tracing"): TracingConfig,
+    ("SMConfig", "telemetry"): TelemetryConfig,
     ("SMConfig", "logs"): LogsConfig,
     ("ServiceConfig", "admission"): AdmissionConfig,
 }

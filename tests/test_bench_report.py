@@ -29,6 +29,7 @@ def test_report_schema_and_values():
         "numpy_floor_n_ions", "floor_procs",
         "numpy_floor_multiproc_ions_per_s", "vs_baseline_multiproc",
         "compile_s", "warmup_retried", "warmup_skipped",
+        "hbm_peak_bytes", "device_kind",
         "xla_cache_entries_before",
         "n_ions", "n_pixels", "pixels_per_s", "isocalc_s",
         "isocalc_cold_s", "isocalc_workers", "patterns_per_s",
@@ -57,6 +58,19 @@ def test_report_schema_and_values():
     assert out["isocalc_cold_s"] is None
     assert out["isocalc_workers"] is None
     assert out["patterns_per_s"] is None
+    # HBM pinning (ISSUE 6 satellite): null when the platform exposes no
+    # memory stats, passed through when measure_jax captured them
+    assert out["hbm_peak_bytes"] is None
+    assert out["device_kind"] is None
+
+
+def test_report_hbm_fields_pass_through():
+    prep, floor, jaxr = _fake_inputs()
+    jaxr["hbm_peak_bytes"] = 1_940_000_000
+    jaxr["device_kind"] = "TPU v5 lite"
+    out = report(prep, floor, jaxr)
+    assert out["hbm_peak_bytes"] == 1_940_000_000
+    assert out["device_kind"] == "TPU v5 lite"
 
 
 def test_report_flags_retried_warmup():

@@ -1,0 +1,216 @@
+"""Prometheus exposition edge cases (ISSUE 6 satellite).
+
+The text format is a protocol: a scraper that receives a raw newline inside
+a label value, or a histogram whose ``+Inf`` bucket undercuts a finite
+bucket (the torn observe-vs-scrape read), silently drops or mangles the
+family.  These tests pin label escaping, bucket monotonicity (including at
+exact boundaries and past the last finite bucket), scrape consistency under
+concurrent observers, and the exception-safe collector dispatch with its
+``sm_metrics_collect_errors_total`` evidence counter.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import threading
+
+import pytest
+
+from sm_distributed_tpu.service.metrics import (
+    MetricsRegistry,
+    rate_collector,
+)
+
+
+def _sample_lines(text: str, family: str) -> list[str]:
+    return [line for line in text.splitlines()
+            if line.startswith(family) and not line.startswith("#")]
+
+
+# ------------------------------------------------------------- label escaping
+def test_label_escaping_newlines_quotes_backslashes():
+    m = MetricsRegistry()
+    c = m.counter("sm_esc_total", 'help with "quotes"\nand a newline',
+                  ("msg",))
+    hostile = 'a"b\nc\\d'
+    c.labels(msg=hostile).inc(3)
+    text = m.expose()
+    lines = _sample_lines(text, "sm_esc_total")
+    assert len(lines) == 1
+    line = lines[0]
+    # escaped per the text format: \\ first, then \" and \n
+    assert 'msg="a\\"b\\nc\\\\d"' in line
+    assert line.endswith(" 3")
+    # no sample or HELP line may contain a raw newline mid-record: every
+    # exposition line must itself parse as `name{labels} value` or a header
+    for ln in text.splitlines():
+        assert "\n" not in ln
+        assert ln.startswith("#") or re.match(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? [^ ]+$", ln), ln
+    # HELP text is escaped too
+    help_line = next(line for line in text.splitlines()
+                     if line.startswith("# HELP sm_esc_total"))
+    assert "\\n" in help_line
+
+
+def test_label_names_are_validated():
+    m = MetricsRegistry()
+    g = m.gauge("sm_lbl", "labelled", ("tenant",))
+    with pytest.raises(ValueError):
+        g.labels(wrong="x")
+    with pytest.raises(ValueError):
+        g.set(1.0)               # unlabelled use of a labelled family
+
+
+# -------------------------------------------------------- bucket monotonicity
+def _parse_histogram(text: str, family: str) -> tuple[list[tuple[str, int]], int, float]:
+    """([(le, cumulative)], count, sum) for an unlabelled histogram."""
+    buckets = []
+    count = None
+    total = None
+    for line in _sample_lines(text, family):
+        name, _, value = line.partition(" ")
+        if name.startswith(f"{family}_bucket"):
+            le = re.search(r'le="([^"]+)"', name).group(1)
+            buckets.append((le, int(value)))
+        elif name == f"{family}_count":
+            count = int(value)
+        elif name == f"{family}_sum":
+            total = float(value)
+    assert count is not None and total is not None
+    return buckets, count, total
+
+
+def test_histogram_inf_bucket_and_boundaries():
+    m = MetricsRegistry()
+    h = m.histogram("sm_h_seconds", "hist", buckets=(1.0, 2.0))
+    for v in (0.5, 1.0, 1.5, 2.0, 99.0):   # two exact boundary hits + overflow
+        h.observe(v)
+    buckets, count, total = _parse_histogram(m.expose(), "sm_h_seconds")
+    assert buckets == [("1", 2), ("2", 4), ("+Inf", 5)]
+    assert count == 5
+    assert total == pytest.approx(104.0)
+    # cumulative counts never decrease, and +Inf equals _count
+    values = [n for _le, n in buckets]
+    assert values == sorted(values)
+    assert buckets[-1][1] == count
+
+
+def test_histogram_only_overflow_observations():
+    m = MetricsRegistry()
+    h = m.histogram("sm_over_seconds", "hist", buckets=(0.1,))
+    h.observe(5.0)
+    h.observe(7.0)
+    buckets, count, _ = _parse_histogram(m.expose(), "sm_over_seconds")
+    assert buckets == [("0.1", 0), ("+Inf", 2)]
+    assert count == 2
+
+
+def test_fraction_below_interpolation():
+    m = MetricsRegistry()
+    h = m.histogram("sm_frac_seconds", "hist", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 100.0):
+        h.observe(v)
+    # exact boundary: everything at or under le=2 -> 2 of 4
+    frac, n = h.fraction_below(2.0)
+    assert n == 4 and frac == pytest.approx(0.5)
+    # interior: le=2 bucket full (2 obs) + half of the (2,4] bucket's 1
+    frac, _ = h.fraction_below(3.0)
+    assert frac == pytest.approx((2 + 0.5) / 4)
+    # beyond the last finite bucket only the overflow observation is out
+    frac, _ = h.fraction_below(4.0)
+    assert frac == pytest.approx(0.75)
+    # empty histogram
+    h2 = m.histogram("sm_frac2_seconds", "hist", buckets=(1.0,))
+    assert h2.fraction_below(1.0) == (0.0, 0)
+
+
+# ------------------------------------------------- concurrent observe vs scrape
+def test_concurrent_observe_vs_scrape_consistency():
+    """A scrape racing observers must stay internally consistent: within
+    one exposition, cumulative buckets are monotone and the +Inf bucket
+    equals _count (the lock-free read used to allow +Inf < a finite
+    bucket)."""
+    m = MetricsRegistry()
+    h = m.histogram("sm_race_seconds", "hist",
+                    buckets=(0.001, 0.01, 0.1, 1.0))
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def observe():
+        rng = random.Random(42)
+        while not stop.is_set():
+            h.observe(rng.random() * 2.0)
+
+    threads = [threading.Thread(target=observe, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(60):
+            buckets, count, total = _parse_histogram(
+                m.expose(), "sm_race_seconds")
+            values = [n for _le, n in buckets]
+            if values != sorted(values):
+                errors.append(f"non-monotone buckets: {buckets}")
+            if buckets[-1][1] != count:
+                errors.append(f"+Inf {buckets[-1][1]} != count {count}")
+            if count and total < 0:
+                errors.append(f"negative sum {total}")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+    assert not errors, errors[:5]
+
+
+# ----------------------------------------------------- collector dispatch
+def test_failing_collector_cannot_break_the_scrape():
+    m = MetricsRegistry()
+    calls = {"good": 0}
+
+    def bad(reg):
+        raise RuntimeError("boom")
+
+    def good(reg):
+        calls["good"] += 1
+        reg.gauge("sm_good_gauge", "still scraped").set(7)
+
+    m.add_collector(bad)        # registered FIRST: must not starve `good`
+    m.add_collector(good)
+    text = m.expose()
+    assert calls["good"] == 1
+    assert "sm_good_gauge 7" in text
+    # the failure is itself observable
+    assert 'sm_metrics_collect_errors_total{collector="' in text
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("sm_metrics_collect_errors_total{"))
+    assert line.endswith(" 1")
+    # and it accumulates per scrape
+    text = m.expose()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("sm_metrics_collect_errors_total{"))
+    assert line.endswith(" 2")
+
+
+def test_rate_collector_with_raising_count_fn():
+    m = MetricsRegistry()
+    state = {"n": 0, "raise": False}
+
+    def count():
+        if state["raise"]:
+            raise OSError("stat source gone")
+        return state["n"]
+
+    rate_collector(m, "sm_rate_per_s", "rate", count)
+    m.expose()                   # first scrape primes the window
+    state["n"] = 100
+    state["raise"] = True
+    text = m.expose()            # broken supplier: scrape survives, counted
+    assert "sm_metrics_collect_errors_total" in text
+    state["raise"] = False
+    text = m.expose()            # recovers with the next scrape
+    rate_line = next(ln for ln in text.splitlines()
+                     if ln.startswith("sm_rate_per_s "))
+    assert float(rate_line.split()[-1]) >= 0.0
