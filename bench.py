@@ -346,6 +346,96 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
                 device_kind=hbm["device_kind"])
 
 
+def _stream_rate(backend, prep: dict, cfg: BenchConfig, label: str) -> dict:
+    """Warmup + median-of-5 pipelined streams for an already-built backend
+    (the same measurement discipline as measure_jax, reused by the
+    multichip section so single-chip and N-chip rates are same-protocol)."""
+    from sm_distributed_tpu.utils.logger import logger
+
+    batches = prep["batches"]
+    t0 = time.perf_counter()
+    backend.warmup(batches)
+    compile_dt = time.perf_counter() - t0
+    stream = batches * cfg.reps
+    n_scored = prep["table"].n_ions * cfg.reps
+    rates = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        backend.score_batches(stream)
+        dt = time.perf_counter() - t0
+        rates.append(n_scored / dt)
+        logger.info("[%s/%s] stream %d: %d ions in %.2fs -> %.1f ions/s",
+                    cfg.name, label, i, n_scored, dt, rates[-1])
+    srt = sorted(rates)
+    return dict(rate=srt[2], spread=(srt[-1] - srt[0]) / srt[2],
+                compile_dt=compile_dt)
+
+
+def measure_multichip(cfg: BenchConfig, prep: dict, cache_dir: Path,
+                      n_devices: int, formulas_axis: int) -> dict:
+    """The ``--devices N`` mode (ISSUE 7): same-run single-chip vs N-chip
+    pjit-sharded rates on the ride-along case.  The single-chip reference
+    is PINNED to chip 0 (1x1 mesh, no collectives) and the N-chip rate
+    runs the GSPMD-sharded pixels×formulas mesh over chips [0, N) — the
+    exact sub-mesh path a ``devices: N`` submit takes through the service's
+    device pool.  Speedup is same-run, same-protocol (median of 5 streams
+    each), mirroring the floor discipline."""
+    import jax
+
+    from sm_distributed_tpu.parallel.sharded import make_jax_backend
+    from sm_distributed_tpu.utils.config import SMConfig
+    from sm_distributed_tpu.utils.logger import logger
+
+    avail = len(jax.devices())
+    n = min(n_devices, avail)
+    if n < n_devices:
+        logger.warning("multichip: only %d of the requested %d devices "
+                       "visible; measuring at %d", avail, n_devices, n)
+    f = formulas_axis if formulas_axis > 0 and n % formulas_axis == 0 else 1
+    base_par = {"formula_batch": cfg.formula_batch,
+                "compile_cache_dir": str(cache_dir / "xla_cache")}
+    base = {"backend": "jax_tpu",
+            "fdr": {"decoy_sample_size": cfg.decoy_sample_size}}
+    sm_single = SMConfig.from_dict(
+        {**base, "parallel": {**base_par, "pixels_axis": 1,
+                              "formulas_axis": 1}})
+    single = make_jax_backend(prep["ds"], prep["ds_config"], sm_single,
+                              restrict_table=prep["table"],
+                              device_indices=(0,))
+    s = _stream_rate(single, prep, cfg, "1-chip")
+    sm_multi = SMConfig.from_dict(
+        {**base, "parallel": {**base_par, "pixels_axis": n // f,
+                              "formulas_axis": f}})
+    multi = make_jax_backend(prep["ds"], prep["ds_config"], sm_multi,
+                             restrict_table=prep["table"],
+                             device_indices=tuple(range(n)))
+    m = _stream_rate(multi, prep, cfg, f"{n}-chip")
+    speedup = m["rate"] / s["rate"]
+    logger.info("[%s] multichip: %.1f ions/s on %d chips vs %.1f on 1 "
+                "-> %.2fx", cfg.name, m["rate"], n, s["rate"], speedup)
+    from sm_distributed_tpu.utils.devicemem import hbm_summary
+
+    hbm = hbm_summary(force_import=True)
+    return {
+        "case": cfg.name,
+        "devices": n,
+        "devices_requested": n_devices,
+        "mesh": {"pixels": n // f, "formulas": f},
+        "value": round(m["rate"], 2),
+        "unit": "ions/s",
+        "jax_spread": round(m["spread"], 4),
+        "compile_s": round(m["compile_dt"], 2),
+        "single_chip_ions_per_s": round(s["rate"], 2),
+        "single_chip_spread": round(s["spread"], 4),
+        "single_chip_compile_s": round(s["compile_dt"], 2),
+        "speedup_vs_single_chip": round(speedup, 3),
+        "n_ions": int(prep["table"].n_ions),
+        "n_pixels": int(prep["ds"].n_pixels),
+        "hbm_peak_bytes": hbm["hbm_peak_bytes"],
+        "device_kind": hbm["device_kind"],
+    }
+
+
 def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
            cfg: BenchConfig | None = None) -> dict:
     iso = iso or {}
@@ -453,7 +543,24 @@ def main() -> None:
     ap.add_argument("--isocalc-device", action="store_true",
                     help="route the cold isocalc measurement through the "
                          "device blur->centroid stage (ops/isocalc_jax.py)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="measure an N-chip pjit-sharded 'multichip' "
+                         "section on the ride-along case (same-run 1-chip "
+                         "vs N-chip speedup; forces N virtual CPU devices "
+                         "when the host platform exposes fewer)")
+    ap.add_argument("--mesh-formulas", type=int, default=1,
+                    help="formulas axis of the multichip mesh (must divide "
+                         "--devices; pixels axis absorbs the rest)")
     args = ap.parse_args()
+
+    # the virtual-mesh flag must land before jax initializes (harmless on
+    # TPU hosts: it only affects the host CPU platform)
+    if args.devices > 1 and "jax" not in sys.modules:
+        flags = [fl for fl in os.environ.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in fl]
+        flags.append(
+            f"--xla_force_host_platform_device_count={args.devices}")
+        os.environ["XLA_FLAGS"] = " ".join(flags)
 
     from sm_distributed_tpu.utils.logger import init_logger
 
@@ -504,6 +611,12 @@ def main() -> None:
     }
     for cfg, p, f, j in zip(configs[1:], preps[1:], floors[1:], jaxrs[1:]):
         out[cfg.name] = report(p, f, j, cfg=cfg)
+    if args.devices > 1:
+        # multichip rides the LAST case (desi on a default run — the
+        # acceptance target — else whatever case this invocation built)
+        out["multichip"] = measure_multichip(
+            configs[-1], preps[-1], cache_dir, args.devices,
+            args.mesh_formulas)
     out["trace_path"] = write_bench_trace(cache_dir, configs, out)
     print(json.dumps(out))
 

@@ -59,9 +59,19 @@ fi
 
 # trace smoke gate (ISSUE 5): the spheroid fixture through the real
 # in-process service with tracing on must yield a schema-valid,
-# Perfetto-loadable trace that scripts/trace_report.py renders
+# Perfetto-loadable trace that scripts/trace_report.py renders.  Then the
+# multichip smoke (ISSUE 7) below proves the device-pool scale-out shape.
 if ! env JAX_PLATFORMS=cpu python scripts/trace_smoke.py; then
     echo "check_tier1: FAIL — trace smoke gate failed" >&2
+    exit 1
+fi
+
+# multichip smoke gate (ISSUE 7): a devices=8 submit through the real
+# scheduler must claim the whole simulated pool, score through the
+# pjit-sharded sub-mesh path, and match the numpy oracle; two 1-chip jobs
+# must hold DISTINCT chips concurrently (no single-token serialization)
+if ! env JAX_PLATFORMS=cpu python scripts/multichip_smoke.py; then
+    echo "check_tier1: FAIL — multichip smoke gate failed" >&2
     exit 1
 fi
 

@@ -50,9 +50,15 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 # bench-case keys, direction: "up" = higher is better (regression when the
 # fresh value drops), "down" = lower is better (regression when it rises)
 _BENCH_RATE_KEYS = ("value", "patterns_per_s", "pixels_per_s",
-                    "numpy_floor_ions_per_s")
-_BENCH_TIME_KEYS = ("compile_s", "isocalc_s", "isocalc_cold_s")
-_CASE_KEYS = ("scale", "desi")          # nested bench cases ride along
+                    "numpy_floor_ions_per_s",
+                    # multichip section (ISSUE 7): the N-chip sharded rate
+                    # ("value" above), the same-run 1-chip reference, and
+                    # the scaling ratio itself are all higher-is-better
+                    "single_chip_ions_per_s", "speedup_vs_single_chip")
+_BENCH_TIME_KEYS = ("compile_s", "isocalc_s", "isocalc_cold_s",
+                    "single_chip_compile_s")
+# nested bench cases ride along ("multichip" appears on --devices N runs)
+_CASE_KEYS = ("scale", "desi", "multichip")
 
 
 def load_artifact(path: str | Path) -> dict:

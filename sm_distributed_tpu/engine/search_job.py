@@ -58,9 +58,12 @@ class SearchJob:
         # service mode: engine/residency.DatasetResidency shared across jobs
         # keeps parsed datasets + compiled backends warm (SURVEY #16 analog)
         self.residency = residency
-        # service scheduler's TPU token (a lock/context manager): when set,
-        # the device-bound compile+search+store phase of concurrent jobs
-        # serializes here while their staging/parse phases overlap
+        # service scheduler's device lease (service/device_pool.py — still
+        # Lock-protocol compatible, so a plain threading.Lock works too):
+        # when set, the device-bound compile+search+store phase runs under
+        # the lease's 1..N chips while staging/parse phases overlap across
+        # jobs.  A 1-chip lease pins scoring to its chip; an N-chip lease
+        # scores through the pjit-sharded sub-mesh (parallel/sharded.py).
         self.device_token = device_token
         # cooperative cancellation (utils/cancel.CancelToken): checked at
         # phase boundaries here and at checkpoint-group boundaries inside
@@ -151,7 +154,14 @@ class SearchJob:
             # HOLD; the acquired event inside marks the boundary, so
             # trace_report can split queue-wait vs token-wait vs compute
             with tracing.span("device_hold"), token:
-                tracing.event("device_token_acquired")
+                # a DeviceLease exposes the granted chip indices; a plain
+                # Lock (legacy callers) has none — the event then matches
+                # the pre-pool shape and the search meshes over all devices
+                lease_devs = getattr(self.device_token, "devices", None)
+                tracing.event(
+                    "device_token_acquired",
+                    **({"devices": [int(i) for i in lease_devs]}
+                       if lease_devs else {}))
                 search = MSMBasicSearch(
                     ds, formulas, self.ds_config, self.sm_config,
                     isocalc_cache_dir=str(Path(self.sm_config.work_dir) / "isocalc_cache"),
@@ -159,6 +169,7 @@ class SearchJob:
                     backend_cache=self.residency,
                     prefetch=prefetch,
                     cancel=self.cancel,
+                    device_indices=lease_devs,
                 )
                 prefetch = None   # ownership passed: search() consumes/cancels
                 bundle = search.search()

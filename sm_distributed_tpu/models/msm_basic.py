@@ -176,16 +176,22 @@ class NumpyBackend:
 
 
 def make_backend(name: str, ds: SpectralDataset, ds_config: DSConfig,
-                 sm_config: SMConfig, table: IsotopePatternTable | None = None):
+                 sm_config: SMConfig, table: IsotopePatternTable | None = None,
+                 device_indices=None):
     """``table``: the search's full ion table, when known up front — the jax
     backends drop dataset peaks outside the union of its windows (exact;
-    the reference's "only hits shuffle" property)."""
+    the reference's "only hits shuffle" property).
+
+    ``device_indices`` (ISSUE 7): the job's device-pool lease chips — 1
+    chip pins the single-device fused graph to it, N chips score through
+    the pjit-sharded sub-mesh; None = config-mesh over all devices."""
     if name == "numpy_ref":
         return NumpyBackend(ds, ds_config)
     if name == "jax_tpu":
         from ..parallel.sharded import make_jax_backend  # deferred: jax import is heavy
 
-        return make_jax_backend(ds, ds_config, sm_config, restrict_table=table)
+        return make_jax_backend(ds, ds_config, sm_config, restrict_table=table,
+                                device_indices=device_indices)
     raise ValueError(f"unknown backend {name!r}")
 
 
@@ -392,6 +398,7 @@ class MSMBasicSearch:
         backend_cache=None,
         prefetch: IsotopePrefetch | None = None,
         cancel=None,
+        device_indices=None,
     ):
         self.ds = ds
         self.formulas = list(dict.fromkeys(formulas))  # dedup, keep order
@@ -410,6 +417,11 @@ class MSMBasicSearch:
         # checked at checkpoint-group boundaries and inside the host
         # backend's per-batch loop
         self.cancel = cancel
+        # the job's device-pool lease chips (ISSUE 7): forwarded into
+        # make_backend so a 1-chip job pins to its chip and an N-chip job
+        # scores through the pjit-sharded sub-mesh; None = all devices
+        self.device_indices = (tuple(int(i) for i in device_indices)
+                               if device_indices else None)
         self.isocalc = None if prefetch is not None else make_isocalc(
             ds_config, self.sm_config, isocalc_cache_dir)
         # populated by search(); the orchestrator reads these to persist ion
@@ -631,6 +643,7 @@ class MSMBasicSearch:
             return make_backend(
                 self.sm_config.backend, self.ds, self.ds_config,
                 self.sm_config, table=table,
+                device_indices=self.device_indices,
             )
 
         # device circuit breaker (models/breaker.py): an OPEN breaker means
@@ -652,7 +665,10 @@ class MSMBasicSearch:
             par = self.sm_config.parallel
             key = (self.sm_config.backend, fingerprint,
                    par.mz_chunk, par.pixels_axis, par.formulas_axis,
-                   par.peak_compaction, par.band_slice, par.order_ions)
+                   par.peak_compaction, par.band_slice, par.order_ions,
+                   # a backend is pinned to its lease's chips — a cached one
+                   # must never be reused by a job holding DIFFERENT chips
+                   self.device_indices)
             backend = self.backend_cache.backend(key, build)
         else:
             backend = build()

@@ -366,11 +366,19 @@ class JaxBackend:
 
     def __init__(self, ds: SpectralDataset, ds_config: DSConfig,
                  sm_config: SMConfig,
-                 restrict_table: IsotopePatternTable | None = None):
+                 restrict_table: IsotopePatternTable | None = None,
+                 device=None):
         from ..parallel.distributed import enable_compile_cache
 
         self.ds = ds
         self.ds_config = ds_config
+        # chip pinning (ISSUE 7): a 1-chip device-pool lease pins this
+        # backend's RESIDENT arrays (and therefore every jitted program —
+        # committed inputs anchor placement, uncommitted batch args follow)
+        # to that jax Device, so two 1-chip jobs compute on distinct chips
+        # concurrently.  None = the process default device (pre-pool
+        # behavior).
+        self.device = device
         enable_compile_cache(sm_config)
         from ..parallel.distributed import compile_cache_path
 
@@ -401,8 +409,8 @@ class JaxBackend:
                     "mz_chunk cube path (dense per-pixel rows); scoring "
                     "the full cube")
             mz_q, int_cube = prepare_cube_arrays(ds, ppm=self.ppm)
-            self._mz_q = jax.device_put(mz_q)
-            self._ints = jax.device_put(int_cube)
+            self._mz_q = jax.device_put(mz_q, self.device)
+            self._ints = jax.device_put(int_cube, self.device)
             logger.info(
                 "jax_tpu cube resident: %s int32 + %s f32 on %s",
                 mz_q.shape, int_cube.shape, self._mz_q.devices(),
@@ -447,8 +455,8 @@ class JaxBackend:
                     100.0 * (1 - n_eff / max(mz_s.size, 1)))
                 mz_s, px_s, in_s = mzk[0], pxk[0], ink[0]
             self._mz_host = mz_s
-            self._px_s = jax.device_put(px_s)
-            self._in_s = jax.device_put(in_s)
+            self._px_s = jax.device_put(px_s, self.device)
+            self._in_s = jax.device_put(in_s, self.device)
             logger.info(
                 "jax_tpu flat peaks resident: %d sorted peaks (%.1f MB) on %s",
                 mz_s.size, (px_s.nbytes + in_s.nbytes) / 1e6,
@@ -940,7 +948,9 @@ class JaxBackend:
         """One async device dispatch, wrapped in a per-batch scoring span.
         The span measures ENQUEUE time (dispatch is async; device compute
         overlaps the stream and is settled by the device_sync span)."""
+        dev_attr = ({"device": int(self.device.id)}
+                    if self.device is not None else {})
         with tracing.span("score_batch", backend="jax_tpu",
-                          ions=int(table.n_ions), enqueue=True):
+                          ions=int(table.n_ions), enqueue=True, **dev_attr):
             return self._dispatch(table, plan) if plan is not None \
                 else self._dispatch(table)

@@ -28,6 +28,31 @@ PIXELS_AXIS = "pixels"
 FORMULAS_AXIS = "formulas"
 
 
+def shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-compatible ``shard_map`` (ISSUE 7 satellite).
+
+    jax >= 0.6 exposes ``jax.shard_map`` with the VMA type-system knob
+    ``check_vma``; the 0.4.x line only ships
+    ``jax.experimental.shard_map.shard_map`` whose equivalent knob is
+    ``check_rep``.  Every mesh-sharded program in this repo goes through
+    this one seam so the rest of parallel/ never has to care which jax is
+    installed.
+    """
+    if hasattr(jax, "shard_map"):
+        try:
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_vma)
+        except TypeError:
+            # transitional releases: jax.shard_map exists but still takes
+            # the old replication-check keyword
+            return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+
 def resolve_axis_sizes(n_devices: int, cfg: ParallelConfig) -> tuple[int, int]:
     """(pixels, formulas) axis sizes using exactly their product <= n_devices.
 
@@ -62,3 +87,29 @@ def make_mesh(cfg: ParallelConfig, devices=None) -> Mesh:
     pix, form = resolve_axis_sizes(len(devices), cfg)
     dev_grid = np.array(devices[: pix * form]).reshape(pix, form)
     return Mesh(dev_grid, (PIXELS_AXIS, FORMULAS_AXIS))
+
+
+def lease_devices(device_indices) -> list | None:
+    """Map a device-pool lease's chip indices (``DeviceLease.devices``) to
+    jax Device objects for a sub-mesh.
+
+    ``None`` -> ``None`` (the caller meshes over ALL local devices, the
+    pre-pool behavior).  Indices beyond the visible device count — a
+    simulated pool larger than the host, e.g. the CI smoke's 8-chip pool on
+    a smaller box — are dropped with a warning; an empty result falls back
+    to ``None`` rather than failing the job over a telemetry-grade
+    mismatch.
+    """
+    if device_indices is None:
+        return None
+    from ..utils.logger import logger
+
+    devs = jax.local_devices()
+    picked = [devs[i] for i in device_indices if 0 <= int(i) < len(devs)]
+    if len(picked) < len(list(device_indices)):
+        logger.warning(
+            "device lease %s exceeds the %d visible jax devices; %s",
+            tuple(device_indices), len(devs),
+            f"using {len(picked)} chip(s)" if picked
+            else "falling back to the config mesh")
+    return picked or None

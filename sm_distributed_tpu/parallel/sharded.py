@@ -58,7 +58,7 @@ from ..utils import tracing
 from ..ops.quantize import quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
-from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh
+from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh, shard_map
 
 
 def build_sharded_score_factory(
@@ -136,7 +136,7 @@ def build_sharded_score_factory(
     def make(gc_width, n_keep=0, w_cap=0):
         from functools import partial
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             partial(step, gc_width=gc_width, n_keep=n_keep, w_cap=w_cap),
             mesh=mesh,
             in_specs=(
@@ -511,7 +511,7 @@ class ShardedJaxBackend:
                 px_s[0], in_s[0], pos[0], rlo, rhi, n_pixels=p_loc)
 
         if not hasattr(self, "_extract_fn"):
-            self._extract_fn = jax.jit(jax.shard_map(
+            self._extract_fn = jax.jit(shard_map(
                 step,
                 mesh=self.mesh,
                 in_specs=(
@@ -553,12 +553,34 @@ class ShardedJaxBackend:
         plans = [self._flat_plan(t) for t in tables]
         self._grow_static_shapes(plans)
         pending = []
+        mesh_ids = [int(d.id) for d in self.mesh.devices.flat]
         for t, plan in zip(tables, plans):
             with tracing.span("score_batch", backend="jax_tpu_sharded",
-                              ions=int(t.n_ions), enqueue=True):
+                              ions=int(t.n_ions), enqueue=True,
+                              mesh=dict(self.mesh.shape)):
                 pending.append(self._dispatch(t, plan))
-        with tracing.span("device_sync", batches=len(pending)):
-            return fetch_scored_batches(pending)
+        # the device_sync span carries the sub-mesh's chip ids, so a trace
+        # shows WHICH chips a sharded group occupied (the PR 5 tracer's
+        # per-device view of the pool lease)
+        with tracing.span("device_sync", batches=len(pending),
+                          devices=mesh_ids):
+            out = fetch_scored_batches(pending)
+        self._trace_mesh_hbm(mesh_ids)
+        return out
+
+    def _trace_mesh_hbm(self, mesh_ids: list[int]) -> None:
+        """Per-chip HBM of THIS mesh's devices onto the ambient trace (the
+        PR 6 telemetry, scoped to the lease) — no-op on platforms without
+        memory stats (CPU)."""
+        from ..utils import devicemem
+
+        per = {
+            str(s["id"]): s["bytes_in_use"]
+            for s in devicemem.device_stats()
+            if s["id"] in set(mesh_ids) and s["bytes_in_use"] is not None
+        }
+        if per:
+            tracing.event("mesh_hbm", devices=per)
 
     def _grow_static_shapes(self, plans) -> None:
         # fixpoint, like JaxBackend._grow_for_stream: growing the compact
@@ -602,19 +624,36 @@ class ShardedJaxBackend:
 
 
 def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig,
-                     sm_config: SMConfig, restrict_table=None):
-    """Pick single-device fused graph or the mesh-sharded variant based on the
-    resolved mesh size (1x1 mesh -> single device, no collectives).
+                     sm_config: SMConfig, restrict_table=None,
+                     device_indices=None):
+    """Pick single-device fused graph or the mesh-sharded variant.
+
+    ``device_indices`` (ISSUE 7): a device-pool lease's chip indices.  A
+    1-chip lease gets the single-device fused graph PINNED to that chip
+    (so two 1-chip jobs score on distinct chips concurrently); an N-chip
+    lease gets the pjit/GSPMD-sharded path over a sub-mesh of exactly
+    those chips.  ``None`` keeps the pre-pool behavior: mesh geometry from
+    ``SMConfig.parallel`` over all local devices (1x1 mesh -> single
+    device, no collectives).
+
     ``restrict_table``: the search's full ion table — peaks outside the
     union of its windows are dropped from the device arrays (exact)."""
     from .distributed import maybe_initialize_distributed
+    from .mesh import lease_devices
 
     maybe_initialize_distributed(sm_config.parallel)  # no-op single-process
-    mesh = make_mesh(sm_config.parallel)
+    devices = lease_devices(device_indices)
+    if devices is not None and len(devices) == 1:
+        from ..models.msm_jax import JaxBackend
+
+        return JaxBackend(ds, ds_config, sm_config,
+                          restrict_table=restrict_table, device=devices[0])
+    mesh = make_mesh(sm_config.parallel, devices=devices)
     if mesh.size == 1:
         from ..models.msm_jax import JaxBackend
 
         return JaxBackend(ds, ds_config, sm_config,
-                          restrict_table=restrict_table)
+                          restrict_table=restrict_table,
+                          device=devices[0] if devices else None)
     return ShardedJaxBackend(ds, ds_config, sm_config, mesh=mesh,
                              restrict_table=restrict_table)

@@ -5,8 +5,10 @@ per daemon process (SURVEY.md #16); this subsystem is the production-serving
 shape the ROADMAP north star asks for on top of the same spool contract:
 
 - ``scheduler``  — concurrent job scheduler: worker pool draining the spool,
-  priority classes + per-tenant fairness, device-bound phases serialized via
-  a TPU token while CPU staging/parse overlap;
+  priority classes + per-tenant fairness, device-bound phases running under
+  per-job **device-pool leases** (``device_pool``: 1..N chips per job —
+  small jobs pack onto distinct chips and run concurrently, sub-mesh jobs
+  score pjit-sharded) while CPU staging/parse overlap;
 - ``scheduler``  — failure policy: per-job timeout with COOPERATIVE
   cancellation (``utils/cancel.CancelToken`` threaded through the job,
   checked at checkpoint-group boundaries), retry with exponential backoff +
@@ -37,6 +39,7 @@ callbacks — see ``tests/test_service.py``.
 """
 
 from .admission import AdmissionController
+from .device_pool import DeviceLease, DevicePool
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .scheduler import JobRecord, JobScheduler, RetryPolicy
 from .server import AnnotationService
@@ -46,7 +49,9 @@ __all__ = [
     "AdmissionController",
     "AnnotationService",
     "Counter",
+    "DeviceLease",
     "DeviceMonitor",
+    "DevicePool",
     "Gauge",
     "Histogram",
     "JobRecord",

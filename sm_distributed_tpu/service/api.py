@@ -80,6 +80,11 @@ def validate_submit(msg) -> list[str]:
     if "deadline_s" in msg:
         if not _is_num(msg["deadline_s"]) or msg["deadline_s"] <= 0:
             errs.append("'deadline_s' must be a positive number of seconds")
+    if "devices" in msg and not (
+            isinstance(msg["devices"], int)
+            and not isinstance(msg["devices"], bool)
+            and msg["devices"] > 0):
+        errs.append("'devices' must be a positive integer chip count")
     svc = msg.get("service", {})
     if not isinstance(svc, dict):
         errs.append("'service' must be an object")
@@ -92,6 +97,12 @@ def validate_submit(msg) -> list[str]:
                 and not isinstance(svc["max_attempts"], bool)
                 and svc["max_attempts"] > 0):
             errs.append("'service.max_attempts' must be a positive integer")
+        if "devices" in svc and not (
+                isinstance(svc["devices"], int)
+                and not isinstance(svc["devices"], bool)
+                and svc["devices"] > 0):
+            errs.append("'service.devices' must be a positive integer "
+                        "chip count")
     return errs
 
 

@@ -1,0 +1,317 @@
+"""Device-pool allocator: 1..N-chip leases instead of ONE TPU token.
+
+ISSUE 7 tentpole.  Since PR 1 the scheduler serialized every job's
+device-bound phase behind a single ``threading.Lock`` (``device_token``) —
+correct on a 1-chip host, but ``MULTICHIP_r*.json`` shows 8 chips visible
+and the lock let exactly one of them work at a time.  This module replaces
+the token with a **pool**:
+
+- a job asks for ``1..N`` chips (``service.devices_per_job`` default, a
+  per-submit ``devices`` field overrides);
+- **small jobs pack**: two 1-chip jobs get DISTINCT chips and run their
+  device phases concurrently;
+- **large jobs claim a contiguous sub-mesh**: an N-chip lease is a
+  contiguous run of device indices, which ``parallel/mesh.make_mesh``
+  turns into a pixels×formulas mesh for the pjit/GSPMD-sharded scoring
+  path (``parallel/sharded.py``);
+- **FIFO-ish fairness**: waiters are served in arrival order; a waiter
+  whose request cannot currently be satisfied is skipped (so small jobs
+  keep packing around a waiting sub-mesh job), but after ``max_bypass``
+  skips the starved waiter *seals* the queue — no later grant is made
+  until the pool drains enough to serve it;
+- **crash/cancel safety**: a lease is released by its ``with`` exit on the
+  happy path AND unconditionally by the scheduler worker's ``finally`` —
+  release is idempotent, and releasing a never-granted lease simply
+  deregisters it from the wait queue (the cancelled-while-waiting path).
+
+Backward compatibility: ``DeviceLease`` speaks the ``threading.Lock``
+protocol (``acquire(timeout=)`` / ``release()`` / ``locked()`` / context
+manager), so ``utils/cancel.hold_cancellable`` — and every callback that
+did ``with ctx.device_token:`` — works unchanged.  ``DevicePool`` itself
+also speaks it (each ``acquire`` takes one chip), so code that poked the
+old ``scheduler.device_token`` lock still behaves.
+
+Metrics (``attach_metrics``): ``sm_device_pool_in_use{device=}``,
+``sm_device_pool_devices``, ``sm_device_pool_waiters``,
+``sm_device_pool_grants_total``, ``sm_device_pool_wait_seconds``.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+
+class DeviceLease:
+    """A (pending or granted) claim on ``n`` chips from a :class:`DevicePool`.
+
+    Lock-protocol compatible: ``acquire`` blocks (or polls, with
+    ``timeout``) until the pool grants a contiguous run of ``n`` chips;
+    the lease KEEPS its queue position across timed-out polls, so the
+    ``hold_cancellable`` poll loop cannot lose its place in line.
+    """
+
+    def __init__(self, pool: "DevicePool", n: int, msg_id: str = ""):
+        self.pool = pool
+        self.n = int(n)
+        self.msg_id = msg_id
+        self.devices: tuple[int, ...] = ()   # granted chip indices
+        self.last_wait_s: float = 0.0        # first-acquire -> grant
+        self._bypassed = 0                   # grants that jumped this waiter
+        self._queued = False
+        self._waiting_since = 0.0
+
+    # ------------------------------------------------- lock protocol
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self.pool._acquire(self, blocking, timeout)
+
+    def release(self) -> None:
+        self.pool._release(self)
+
+    def locked(self) -> bool:
+        return bool(self.devices)
+
+    def __enter__(self) -> "DeviceLease":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        state = f"devices={self.devices}" if self.devices else \
+            ("waiting" if self._queued else "idle")
+        return f"DeviceLease(n={self.n}, msg_id={self.msg_id!r}, {state})"
+
+
+class DevicePool:
+    """Allocate contiguous chip runs to leases, FIFO-ish, crash-safe."""
+
+    def __init__(self, size: int, max_bypass: int = 64):
+        if size <= 0:
+            raise ValueError(f"device pool size must be positive, got {size}")
+        self.size = int(size)
+        self.max_bypass = max(0, int(max_bypass))
+        self._cond = threading.Condition()
+        self._owner: list[DeviceLease | None] = [None] * self.size
+        self._waiters: list[DeviceLease] = []
+        self._compat: list[DeviceLease] = []   # legacy single-token grants
+        self.grants_total = 0
+        self.releases_total = 0
+        self._m_grants = None
+        self._m_wait = None
+        self._m_in_use = None
+        self._m_waiters = None
+
+    # ------------------------------------------------------------ metrics
+    def attach_metrics(self, registry) -> None:
+        if self._m_grants is not None:
+            return
+        self._m_grants = registry.counter(
+            "sm_device_pool_grants_total", "Device-pool leases granted")
+        self._m_wait = registry.histogram(
+            "sm_device_pool_wait_seconds",
+            "Lease wait from first acquire to grant",
+            buckets=(0.001, 0.01, 0.1, 0.5, 1.0, 5.0, 30.0, 120.0, 600.0))
+        self._m_in_use = registry.gauge(
+            "sm_device_pool_in_use",
+            "1 when the chip is held by a job lease, per device", ("device",))
+        for i in range(self.size):
+            self._m_in_use.labels(device=str(i)).set(0)
+        registry.gauge(
+            "sm_device_pool_devices",
+            "Chips in the scheduler's device pool").set(self.size)
+        self._m_waiters = registry.gauge(
+            "sm_device_pool_waiters", "Leases currently waiting for chips")
+
+    # ---------------------------------------------------------- inspection
+    def lease(self, n: int, msg_id: str = "") -> DeviceLease:
+        """A new unacquired lease for ``n`` chips (clamped to the pool)."""
+        return DeviceLease(self, max(1, min(int(n), self.size)), msg_id)
+
+    def in_use_count(self) -> int:
+        with self._cond:
+            return sum(o is not None for o in self._owner)
+
+    def per_device_in_use(self) -> list[bool]:
+        with self._cond:
+            return [o is not None for o in self._owner]
+
+    def occupancy(self) -> float:
+        """Fraction of chips currently held (the pool-wide ratio the old
+        single-token occupancy generalizes to)."""
+        return self.in_use_count() / self.size
+
+    def waiters(self) -> int:
+        with self._cond:
+            return len(self._waiters)
+
+    def snapshot(self) -> dict:
+        """One point-in-time view (telemetry ring / debugging)."""
+        with self._cond:
+            return {
+                "size": self.size,
+                "in_use": sum(o is not None for o in self._owner),
+                "waiters": len(self._waiters),
+                "grants_total": self.grants_total,
+                "holders": {
+                    str(i): o.msg_id for i, o in enumerate(self._owner)
+                    if o is not None},
+            }
+
+    # ---------------------------------------------------- grant machinery
+    def _find_run(self, n: int) -> int | None:
+        """First start index of a contiguous free run of length ``n``."""
+        run = 0
+        for i in range(self.size):
+            run = run + 1 if self._owner[i] is None else 0
+            if run == n:
+                return i - n + 1
+        return None
+
+    def _grant_allowed(self, lease: DeviceLease) -> bool:
+        """FIFO-ish admission (caller holds the lock): every EARLIER waiter
+        either (a) can be satisfied right now — it wins, we wait; (b) cannot
+        and has bypass budget left — skip it (small jobs pack around a
+        waiting sub-mesh job); or (c) cannot and is starved past
+        ``max_bypass`` — the queue is sealed behind it."""
+        for w in self._waiters:
+            if w is lease:
+                return True
+            if self._find_run(w.n) is not None:
+                return False
+            if w._bypassed >= self.max_bypass:
+                return False
+        return True
+
+    def _grant(self, lease: DeviceLease, start: int) -> None:
+        for w in self._waiters:
+            if w is lease:
+                break
+            w._bypassed += 1
+        self._waiters.remove(lease)
+        lease._queued = False
+        lease.devices = tuple(range(start, start + lease.n))
+        for i in lease.devices:
+            self._owner[i] = lease
+        self.grants_total += 1
+        lease.last_wait_s = time.monotonic() - lease._waiting_since
+        if self._m_grants is not None:
+            self._m_grants.inc()
+            self._m_wait.observe(lease.last_wait_s)
+            for i in lease.devices:
+                self._m_in_use.labels(device=str(i)).set(1)
+            self._m_waiters.set(len(self._waiters))
+
+    def _acquire(self, lease: DeviceLease, blocking: bool,
+                 timeout: float) -> bool:
+        deadline = (time.monotonic() + timeout
+                    if blocking and timeout is not None and timeout >= 0
+                    else None)
+        with self._cond:
+            if lease.devices:
+                raise RuntimeError(
+                    f"lease for {lease.msg_id or 'anonymous'} already holds "
+                    f"devices {lease.devices}")
+            if not lease._queued:
+                lease._queued = True
+                lease._bypassed = 0
+                lease._waiting_since = time.monotonic()
+                self._waiters.append(lease)
+                if self._m_waiters is not None:
+                    self._m_waiters.set(len(self._waiters))
+            while True:
+                if self._grant_allowed(lease):
+                    start = self._find_run(lease.n)
+                    if start is not None:
+                        self._grant(lease, start)
+                        return True
+                if not blocking:
+                    return False     # stays queued — position is retained
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False  # stays queued — position is retained
+                    self._cond.wait(remaining)
+                else:
+                    self._cond.wait()
+
+    def _release(self, lease: DeviceLease) -> None:
+        """Idempotent: frees granted chips, or deregisters a still-waiting
+        lease (cancel/crash while queued), or no-ops."""
+        with self._cond:
+            if lease._queued:
+                try:
+                    self._waiters.remove(lease)
+                except ValueError:
+                    pass
+                lease._queued = False
+                if self._m_waiters is not None:
+                    self._m_waiters.set(len(self._waiters))
+            if lease.devices:
+                for i in lease.devices:
+                    if self._owner[i] is lease:
+                        self._owner[i] = None
+                if self._m_in_use is not None:
+                    for i in lease.devices:
+                        self._m_in_use.labels(device=str(i)).set(0)
+                lease.devices = ()
+                self.releases_total += 1
+            self._cond.notify_all()
+
+    # ------------------------------------- legacy single-token protocol
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        """Back-compat with the old ``scheduler.device_token`` Lock: each
+        call takes ONE chip; ``release`` frees the most recent grant."""
+        lease = self.lease(1, msg_id="_token")
+        ok = lease.acquire(blocking=blocking, timeout=timeout)
+        if ok:
+            with self._cond:
+                self._compat.append(lease)
+        else:
+            lease.release()              # deregister the failed waiter
+        return ok
+
+    def release(self) -> None:
+        with self._cond:
+            if not self._compat:
+                raise RuntimeError("release of un-acquired device-pool token")
+            lease = self._compat.pop()
+        lease.release()
+
+    def locked(self) -> bool:
+        """The single-token analog: True when EVERY chip is held."""
+        with self._cond:
+            return all(o is not None for o in self._owner)
+
+    def __enter__(self) -> "DevicePool":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+
+def resolve_pool_size(cfg=None, backend: str | None = None) -> int:
+    """Pool size: an explicit ``service.device_pool_size`` wins; 0 = auto —
+    the local jax device count when this process uses (or, for the
+    ``jax_tpu`` backend, will use) jax, else 1 chip, which reproduces the
+    old single-token behavior exactly."""
+    explicit = int(getattr(cfg, "device_pool_size", 0) or 0)
+    if explicit > 0:
+        return explicit
+    mod = sys.modules.get("jax")
+    if mod is None and backend == "jax_tpu":
+        try:
+            import jax as mod  # noqa: F811 — the serve path needs it anyway
+        except Exception:
+            return 1
+    if mod is None:
+        return 1
+    try:
+        return max(1, int(mod.local_device_count()))
+    except Exception:
+        return 1

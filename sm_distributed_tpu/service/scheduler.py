@@ -7,12 +7,16 @@ Replaces the daemon's one-message-at-a-time blocking loop
   (priority class, per-tenant fairness, FIFO) order, claiming each by the
   same atomic rename the daemon uses, into a bounded hand-off queue;
 - a **worker pool** executes claimed jobs concurrently.  Device-bound
-  phases are serialized through a single **TPU token** (``device_token``,
-  handed to the callback via ``JobContext`` and acquired inside
-  ``SearchJob.run`` around the compiled-search phase) so CPU-bound
-  staging/parse of the next job overlaps the current job's device time —
-  the service-level analog of the host/device pipelining the backends do
-  per batch;
+  phases go through the **device pool** (``service/device_pool.py``): each
+  job gets a ``DeviceLease`` for 1..N chips (``service.devices_per_job``
+  default, per-submit ``devices`` override), handed to the callback via
+  ``JobContext.device_token`` — still Lock-protocol compatible, acquired
+  inside ``SearchJob.run`` around the compiled-search phase.  Small jobs
+  pack onto DISTINCT chips and run their device phases concurrently;
+  large jobs claim a contiguous sub-mesh and score through the
+  pjit-sharded path (``parallel/sharded.py``).  CPU-bound staging/parse
+  still overlaps device time — the service-level analog of the
+  host/device pipelining the backends do per batch;
 - a **failure policy**: per-job timeout (message ``timeout_s`` overrides
   the config default), retry with exponential backoff + jitter, bounded
   attempts, then dead-letter into ``failed/`` with the recorded traceback.
@@ -72,6 +76,7 @@ from ..utils.cancel import CancelToken, DeadlineExceededError, JobCancelledError
 from ..utils.config import ServiceConfig
 from ..utils.failpoints import failpoint, register_failpoint
 from ..utils.logger import logger
+from .device_pool import DevicePool, resolve_pool_size
 
 FP_RETRY_PUBLISH = register_failpoint(
     "sched.retry_publish",
@@ -162,7 +167,10 @@ class JobContext:
 
     msg_id: str
     attempt: int
-    device_token: threading.Lock = field(repr=False, default=None)
+    # this job's DeviceLease (service/device_pool.py): Lock-protocol
+    # compatible — ``with ctx.device_token:`` still works — but a grant is
+    # 1..N chips (``.devices`` after acquire), not the old global token
+    device_token: object = field(repr=False, default=None)
     metrics: object = field(repr=False, default=None)
     # cooperative cancellation: callbacks check this at phase / checkpoint-
     # group boundaries (utils/cancel.CancelToken; None for legacy callers)
@@ -232,6 +240,7 @@ class JobScheduler:
         admission=None,
         trace_dir: str | Path | None = None,
         slo=None,
+        device_pool: DevicePool | None = None,
     ):
         self.root = Path(queue_dir) / queue
         for s in _STATES:
@@ -253,8 +262,15 @@ class JobScheduler:
         # SLO tracker (service/telemetry.py): queue-wait observed at each
         # job's first attempt start, e2e latency at every terminal outcome
         self.slo = slo
-        # ONE token: device-bound phases of concurrent jobs serialize here
-        self.device_token = threading.Lock()
+        # the device POOL (ISSUE 7): jobs lease 1..N chips; small jobs pack
+        # onto distinct chips, sub-mesh jobs claim contiguous runs.  The
+        # pool still speaks the old single-token Lock protocol, and
+        # ``device_token`` stays as the back-compat alias for code that
+        # poked the PR 1 lock directly.
+        self.device_pool = device_pool if device_pool is not None else \
+            DevicePool(resolve_pool_size(self.cfg),
+                       max_bypass=self.cfg.device_pool_max_bypass)
+        self.device_token = self.device_pool
         self._records: dict[str, JobRecord] = {}
         self._records_lock = threading.Lock()
         # live attempts by msg_id: (CancelToken, _Attempt) — the seam the
@@ -296,6 +312,9 @@ class JobScheduler:
         self.m_backoff = m.histogram(
             "sm_retry_backoff_seconds", "Backoff delays scheduled before retries",
             buckets=(0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0))
+        # per-chip in_use gauge + grant/wait metrics (idempotent when the
+        # service already attached them to the shared pool)
+        self.device_pool.attach_metrics(m)
         m.add_collector(self._collect_queue_depths)
 
     def _collect_queue_depths(self, m) -> None:
@@ -517,6 +536,21 @@ class JobScheduler:
         return int(svc.get("max_attempts", msg.get("max_attempts",
                                                    self.retry.max_attempts)))
 
+    def _job_devices(self, msg: dict) -> int:
+        """Chips this job's lease asks for: per-submit ``devices`` (or
+        ``service.devices``) overrides ``service.devices_per_job``; the
+        result is clamped to [1, pool size] so an 8-chip submit on a 4-chip
+        pool degrades to the whole pool instead of waiting forever."""
+        svc = msg.get("service", {}) if isinstance(msg, dict) else {}
+        raw = svc.get("devices", (msg or {}).get(
+            "devices", self.cfg.devices_per_job)) if isinstance(msg, dict) \
+            else self.cfg.devices_per_job
+        try:
+            n = int(raw)
+        except (TypeError, ValueError):
+            n = self.cfg.devices_per_job
+        return max(1, min(n, self.device_pool.size))
+
     def _deadline_at(self, msg: dict) -> float:
         """Absolute deadline for a message: ``service.deadline_at`` (set by
         the API from ``deadline_s`` at submit) wins; a raw ``deadline_s`` is
@@ -547,6 +581,8 @@ class JobScheduler:
         msg_id = claimed.stem
         rec = self._record(msg_id)
         hb = None
+        lease = None
+        attempt = None
         running_metric = False
         try:
             if rec.cancel_requested:
@@ -592,8 +628,14 @@ class JobScheduler:
                 self.slo.job_started(msg_id, _start, rec.started_at,
                                      rec.attempts)
             attempt_trace = root.child()
+            # this attempt's chip lease: acquired INSIDE the callback
+            # (SearchJob's device_hold seam / hold_cancellable), released by
+            # its ``with`` exit — and unconditionally in the finally below,
+            # so a crashed or abandoned attempt can never leak chips
+            lease = self.device_pool.lease(self._job_devices(msg),
+                                           msg_id=msg_id)
             ctx = JobContext(msg_id=msg_id, attempt=rec.attempts,
-                             device_token=self.device_token,
+                             device_token=lease,
                              metrics=self.metrics, cancel=token,
                              trace=attempt_trace)
             attempt = _Attempt(self.callback, msg, ctx, self._cb_takes_ctx)
@@ -666,6 +708,22 @@ class JobScheduler:
         finally:
             with self._records_lock:
                 self._live.pop(msg_id, None)
+            if lease is not None:
+                if attempt is None or not attempt.is_alive():
+                    # idempotent: normally already released by the callback's
+                    # ``with`` exit; this is the cancel/crash backstop (pool
+                    # invariant: a dead attempt never holds chips)
+                    lease.release()
+                elif lease.locked():
+                    # abandoned zombie still computing: leaking its chips
+                    # beats granting them to a second job mid-flight — the
+                    # zombie's own ``with`` exit releases them if it ever
+                    # reaches a cooperative boundary
+                    logger.warning(
+                        "scheduler: abandoned attempt for %s still holds "
+                        "devices %s", msg_id, lease.devices)
+                else:
+                    lease.release()   # zombie never got a grant: deregister
             if hb is not None:
                 hb.stop()
             if running_metric:

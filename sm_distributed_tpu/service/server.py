@@ -25,6 +25,7 @@ from ..utils.failpoints import attach_metrics as attach_failpoint_metrics
 from ..utils.logger import add_phase_observer, logger, remove_phase_observer
 from .admission import AdmissionController
 from .api import AdminAPI
+from .device_pool import DevicePool, resolve_pool_size
 from .metrics import MetricsRegistry, build_info_collector, process_collector
 from .scheduler import JobScheduler
 from .telemetry import DeviceMonitor, SLOTracker
@@ -62,16 +63,25 @@ class AnnotationService:
         # annotation / e2e histograms recorded at the scheduler's seams,
         # attainment served by GET /slo
         self.slo = SLOTracker(self.metrics, self.sm_config.telemetry)
+        # multi-chip device pool (ISSUE 7): resolved against the configured
+        # backend so a jax_tpu service leases out every visible chip, while
+        # a numpy_ref service keeps the degenerate 1-chip pool (= the old
+        # single-token serialization)
+        self.device_pool = DevicePool(
+            resolve_pool_size(cfg, backend=self.sm_config.backend),
+            max_bypass=cfg.device_pool_max_bypass)
+        self.device_pool.attach_metrics(self.metrics)
         self.scheduler = JobScheduler(
             queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
-            admission=self.admission, trace_dir=self.trace_dir, slo=self.slo)
+            admission=self.admission, trace_dir=self.trace_dir, slo=self.slo,
+            device_pool=self.device_pool)
         # device & memory telemetry: HBM/occupancy/cache sampler feeding
         # gauges + the GET /debug/timeseries snapshot ring
         from ..parallel.distributed import compile_cache_path
 
         self.telemetry = DeviceMonitor(
             self.metrics, self.sm_config.telemetry,
-            device_token=self.scheduler.device_token,
+            device_pool=self.device_pool,
             queue_root=self.queue_dir / queue,
             compile_cache_dir=compile_cache_path(self.sm_config))
         # device-backend circuit breaker: configure the process singleton

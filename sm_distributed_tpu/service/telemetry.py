@@ -54,10 +54,17 @@ class DeviceMonitor:
 
     def __init__(self, registry, cfg: TelemetryConfig | None = None,
                  device_token=None, queue_root: str | Path | None = None,
-                 compile_cache_dir: str | Path | None = None):
+                 compile_cache_dir: str | Path | None = None,
+                 device_pool=None):
         self.registry = registry
         self.cfg = cfg or TelemetryConfig()
-        # the scheduler's TPU token (threading.Lock): sampled, never taken
+        # the scheduler's device pool (service/device_pool.py) — or, for
+        # legacy callers, the old single TPU token (threading.Lock).  A
+        # pool passed via ``device_token`` (the pool speaks the Lock
+        # protocol) is recognized by duck-typing.  Sampled, never taken.
+        if device_pool is None and hasattr(device_token, "per_device_in_use"):
+            device_pool, device_token = device_token, None
+        self.device_pool = device_pool
         self.device_token = device_token
         self.queue_root = Path(queue_root) if queue_root else None
         self.compile_cache_dir = (Path(compile_cache_dir)
@@ -84,7 +91,12 @@ class DeviceMonitor:
             "sm_device_count", "Local accelerator devices visible to jax")
         self.g_occupancy = m.gauge(
             "sm_device_token_occupancy_ratio",
-            "Fraction of recent samples that found the device token held")
+            "Fraction of recent samples that found the device token held "
+            "(with a device pool: windowed mean of the pool-wide in-use "
+            "ratio)")
+        self.g_pool_ratio = m.gauge(
+            "sm_device_pool_occupancy_ratio",
+            "Fraction of pool chips currently held by job leases")
         self.g_phase_hbm = m.gauge(
             "sm_phase_hbm_peak_bytes",
             "Peak HBM observed at each pipeline phase's exit", ("phase",))
@@ -142,7 +154,20 @@ class DeviceMonitor:
         self.g_devices.set(len(devices))
 
         locked = None
-        if self.device_token is not None:
+        pool_snap = None
+        if self.device_pool is not None:
+            # per-chip pool occupancy (ISSUE 7 satellite): the pool updates
+            # its own sm_device_pool_in_use{device=} gauge at grant/release
+            # (event-exact); here we sample the pool-WIDE ratio into the
+            # window + ring so /debug/timeseries shows the saturation trend
+            pool_snap = self.device_pool.snapshot()
+            ratio = pool_snap["in_use"] / max(1, pool_snap["size"])
+            locked = pool_snap["in_use"] >= pool_snap["size"]
+            self.g_pool_ratio.set(ratio)
+            self._occ.append(ratio)
+            occupancy = sum(self._occ) / len(self._occ)
+            self.g_occupancy.set(occupancy)
+        elif self.device_token is not None:
             locked = bool(self.device_token.locked())
             self._occ.append(1.0 if locked else 0.0)
             occupancy = sum(self._occ) / len(self._occ)
@@ -173,6 +198,13 @@ class DeviceMonitor:
             "xla_cache_bytes": cache_bytes,
             "rss_bytes": _rss_bytes(),
         }
+        if pool_snap is not None:
+            snap["device_pool_size"] = pool_snap["size"]
+            snap["device_pool_in_use"] = pool_snap["in_use"]
+            snap["device_pool_ratio"] = round(
+                pool_snap["in_use"] / max(1, pool_snap["size"]), 4)
+            snap["device_pool_waiters"] = pool_snap["waiters"]
+            snap["device_pool_grants_total"] = pool_snap["grants_total"]
         if self.queue_root is not None:
             try:
                 snap["queue_pending"] = len(

@@ -239,6 +239,22 @@ class ServiceConfig:
     quarantine_after: int = 8            # claims without a terminal outcome
                                          # before a message moves to
                                          # quarantine/; 0 disables
+    # --- multi-chip device pool (service/device_pool.py, ISSUE 7) ---
+    device_pool_size: int = 0            # chips the scheduler leases out;
+                                         # 0 = auto (local jax device count
+                                         # when the backend uses jax, else 1
+                                         # — the old single-token behavior)
+    devices_per_job: int = 1             # chips a job claims by default; a
+                                         # per-submit "devices" field
+                                         # overrides.  1 = pack small jobs
+                                         # onto distinct chips; >1 = claim a
+                                         # contiguous sub-mesh and score
+                                         # through the pjit-sharded path
+    device_pool_max_bypass: int = 64     # grants that may jump a waiting
+                                         # larger lease before it seals the
+                                         # queue (anti-starvation for
+                                         # sub-mesh jobs under small-job
+                                         # traffic)
     # --- device-backend circuit breaker (models/breaker.py) ---
     breaker_threshold: int = 3           # consecutive device errors → open
     breaker_cooldown_s: float = 30.0     # open → half-open probe delay
@@ -258,6 +274,11 @@ class ServiceConfig:
         if self.breaker_threshold <= 0 or self.breaker_cooldown_s < 0 or \
                 self.breaker_degraded_batch <= 0:
             raise ValueError("service: breaker knobs out of range")
+        if self.device_pool_size < 0 or self.devices_per_job <= 0 or \
+                self.device_pool_max_bypass < 0:
+            raise ValueError("service: device-pool knobs out of range "
+                             "(device_pool_size >= 0, devices_per_job >= 1, "
+                             "device_pool_max_bypass >= 0)")
 
 
 @dataclass(frozen=True)

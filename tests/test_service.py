@@ -152,7 +152,9 @@ def test_service_integration_scheduler_retry_metrics_shutdown(tmp_path):
 
 
 def test_scheduler_concurrency_and_device_token_serialization(tmp_path):
-    """Workers overlap CPU phases; the TPU token serializes device holders."""
+    """Workers overlap CPU phases; on a 1-chip pool (the old single-token
+    configuration, pinned explicitly now that the pool auto-sizes to the
+    visible devices) device holders still serialize."""
     active = []
     peak = [0]
     token_overlap = [0]
@@ -173,7 +175,8 @@ def test_scheduler_concurrency_and_device_token_serialization(tmp_path):
         with lock:
             active.remove(msg["ds_id"])
 
-    sched = JobScheduler(tmp_path / "q", cb, config=_fast_cfg(workers=3))
+    sched = JobScheduler(tmp_path / "q", cb,
+                         config=_fast_cfg(workers=3, device_pool_size=1))
     pub = QueuePublisher(tmp_path / "q")
     for i in range(6):
         pub.publish({"ds_id": f"j{i}", "input_path": "/in", "msg_id": f"j{i}"})
