@@ -156,6 +156,20 @@ SCENARIOS: list[Scenario] = [
              "isocalc.worker=crash@2;isocalc.shard_load=raise:OSError@1",
              "cache shard read error degrades to recompute, not a crash",
              spec_runs=2, env={"SM_ISOCALC_CHUNK": "32"}),
+    # --- multi-replica lease/fencing seams (ISSUE 8) -------------------
+    Scenario("lease.renew", "consume", "lease.renew=raise:OSError@1",
+             "lease renewal I/O fault; the claim survives the beat and the "
+             "job completes"),
+    Scenario("lease.fence_reject", "consume", "lease.fence_reject=raise@1",
+             "simulated peer fence-out at the first write gate; the holder "
+             "abandons ALL writes, the claim is recovered and rerun cleanly"),
+    Scenario("replica.heartbeat", "consume",
+             "replica.heartbeat=raise:OSError@2",
+             "registry beat write fault; the replica loop survives and the "
+             "job completes (the register-time beat is hit 1)"),
+    Scenario("takeover.scan", "consume", "takeover.scan=crash@1",
+             "crash inside the startup takeover/orphan scan; restart "
+             "re-adopts the shards and drains the spool"),
     # --- overload/cancellation seams (ISSUE 4) -------------------------
     Scenario("sched.cancel_deliver", "consume",
              "sched.cancel_deliver=crash@1;device.score_batch=sleep:5",
@@ -262,6 +276,12 @@ class Context:
         consumer = QueueConsumer(self.queue_dir, callback=None)
         consumer.requeue_stale(max_age_s=0.0)
         consumer.sweep_orphans(max_age_s=0.0)
+        # lease/registry debris from a crashed scheduler (ISSUE 8): orphan
+        # leases and torn tmp writes have no live writer after the crash
+        from sm_distributed_tpu.service.leases import LeaseStore
+
+        LeaseStore(self.root, "recovery").sweep_orphans(
+            self.root, max_age_s=0.0)
         for p in (self.root / "failed").glob("*.json"):
             msg = json.loads(p.read_text())
             for k in ("error", "traceback", "attempts", "service"):

@@ -75,6 +75,15 @@ if ! env JAX_PLATFORMS=cpu python scripts/multichip_smoke.py; then
     exit 1
 fi
 
+# replica failover smoke gate (ISSUE 8): 3 real scheduler replica
+# processes over one partitioned spool; killing one mid-score (and pausing
+# one into a fence race) must converge every job exactly-once to the
+# golden report, with survivors' sm_replica_* metrics proving the takeover
+if ! env JAX_PLATFORMS=cpu python scripts/replica_chaos.py --smoke; then
+    echo "check_tier1: FAIL — replica failover smoke gate failed" >&2
+    exit 1
+fi
+
 # perf-sentinel self-check (ISSUE 6): the regression gate itself is gated —
 # the newest committed BENCH_r*.json must pass against its own history AND
 # a synthetically degraded copy must trip the sentinel

@@ -25,7 +25,15 @@ serving invariants after each mix:
 - **breaker** (full matrix only): with ``backend=jax_tpu`` and injected
   device errors (``backend.device_error`` failpoint), the circuit breaker
   demonstrably opens, jobs degrade to numpy scoring, and after the faults
-  are healed a half-open probe closes it again.
+  are healed a half-open probe closes it again;
+- **replicas** (full matrix only, ISSUE 8): a 10k-tenant-id traffic model
+  over THREE real scheduler replica processes sharing one partitioned
+  spool (``scripts/replica_chaos.py --replica-serve --bare`` — null jobs,
+  the mix measures the SCHEDULING plane).  One replica is SIGKILLed
+  mid-sweep; the survivors fence + take over its shards and the asserts
+  are: every job terminal in ``done/`` exactly once, p99 queue-wait
+  bounded, and tenant-hash-bucket fairness (no bucket's mean wait runs
+  away from the global median).
 
 Usage::
 
@@ -446,6 +454,134 @@ def mix_breaker(base: Path, fx: dict) -> None:
         breaker_mod.reset_device_breaker()
 
 
+def mix_replicas(base: Path, n_jobs: int = 600, tenant_space: int = 10_000,
+                 n_replicas: int = 3, p99_bound_s: float = 30.0) -> None:
+    """Multi-replica, 10k-tenant scheduling-plane mix with a mid-sweep
+    replica kill (ISSUE 8 satellite; ROADMAP open item 2).
+
+    Jobs are null callbacks (``--bare``): the mix measures claim latency,
+    shard partitioning, takeover, and fairness — not scoring.  Queue wait
+    per message is read back from the drained spool (the scheduler stamps
+    ``service.claimed_at`` at every claim)."""
+    import signal as _signal
+    import subprocess
+
+    rng = __import__("random").Random(8)
+    mix_dir = base / "replicas"
+    queue_dir = mix_dir / "queue"
+    root = queue_dir / "sm_annotate"
+    sm = {
+        "backend": "numpy_ref",
+        "work_dir": str(mix_dir / "work"),
+        "storage": {"results_dir": str(mix_dir / "results")},
+        "service": {
+            "workers": 4, "poll_interval_s": 0.02, "job_timeout_s": 30.0,
+            "max_attempts": 2, "backoff_base_s": 0.05, "backoff_max_s": 0.2,
+            "backoff_jitter": 0.0, "heartbeat_interval_s": 0.2,
+            "stale_after_s": 1.0, "drain_timeout_s": 20.0, "http_port": 0,
+            "replicas": n_replicas, "spool_shards": 16,
+            "replica_heartbeat_interval_s": 0.25,
+            "replica_stale_after_s": 1.0, "takeover_interval_s": 0.3,
+        },
+    }
+    mix_dir.mkdir(parents=True, exist_ok=True)
+    sm_conf = mix_dir / "sm.json"
+    sm_conf.write_text(json.dumps(sm, indent=2))
+    from sm_distributed_tpu.engine.daemon import QueuePublisher
+
+    pub = QueuePublisher(queue_dir)
+    t_publish = time.time()
+    for i in range(n_jobs):
+        pub.publish({
+            "ds_id": f"lj{i}", "msg_id": f"lj{i:05d}",
+            "input_path": "null://", "tenant": f"t{rng.randrange(tenant_space)}",
+        })
+    script = str(REPO_ROOT / "scripts" / "replica_chaos.py")
+    env = dict(__import__("os").environ)
+    env.pop("SM_FAILPOINTS", None)
+    procs = {}
+    logs = {}
+    for i in range(n_replicas):
+        rid = f"r{i}"
+        log = open(mix_dir / f"{rid}.log", "w")
+        logs[rid] = log
+        procs[rid] = subprocess.Popen(
+            [sys.executable, script, "--replica-serve", str(queue_dir),
+             str(sm_conf), "--replica-id", rid, "--bare",
+             "--null-sleep", "0.002", "--idle-exit", "2.0"],
+            env=env, stdout=log, stderr=log, cwd=str(REPO_ROOT))
+    victim = procs["r0"]
+    killed = False
+    deadline = time.time() + 300.0
+    try:
+        while time.time() < deadline:
+            done = len(list((root / "done").glob("*.json")))
+            if not killed and done >= n_jobs // 3:
+                # mid-sweep kill: no drain, no cleanup — claims die in
+                # running/ and the survivors must fence + take them over
+                victim.send_signal(_signal.SIGKILL)
+                killed = True
+                print(f"  replicas: killed r0 at {done}/{n_jobs} done")
+            if done >= n_jobs:
+                break
+            if all(p.poll() is not None for p in procs.values()):
+                raise SweepError(
+                    f"replicas: all exited at {done}/{n_jobs} done")
+            time.sleep(0.1)
+        else:
+            raise SweepError(
+                f"replicas: did not drain in time "
+                f"({len(list((root / 'done').glob('*.json')))}/{n_jobs})")
+        _check(killed, "replicas: kill point never reached")
+        drain_s = time.time() - t_publish
+        for rid, p in procs.items():
+            if rid == "r0":
+                continue
+            p.wait(timeout=30)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for log in logs.values():
+            log.close()
+    # ---- invariants from the drained spool -----------------------------
+    done_msgs = list((root / "done").glob("*.json"))
+    _check(len(done_msgs) == n_jobs,
+           f"replicas: {len(done_msgs)}/{n_jobs} done")
+    for state in ("pending", "running", "failed", "quarantine"):
+        left = list((root / state).glob("*.json"))
+        _check(not left, f"replicas: {len(left)} messages left in {state}/")
+    waits_by_bucket: dict[int, list[float]] = {}
+    waits = []
+    import zlib
+
+    for p in done_msgs:
+        msg = json.loads(p.read_text())
+        svc = msg.get("service", {})
+        w = float(svc.get("claimed_at", 0.0)) - float(msg["published_at"])
+        _check(w >= 0, f"replicas: negative queue wait on {p.name}")
+        waits.append(w)
+        bucket = zlib.crc32(str(msg.get("tenant")).encode()) % 10
+        waits_by_bucket.setdefault(bucket, []).append(w)
+    waits.sort()
+    p50 = waits[len(waits) // 2]
+    p99 = waits[min(len(waits) - 1, int(len(waits) * 0.99))]
+    _check(p99 <= p99_bound_s,
+           f"replicas: p99 queue wait {p99:.2f}s > bound {p99_bound_s}s")
+    # fairness across the 10k-tenant space: hash tenants into 10 buckets;
+    # no bucket's MEAN wait may run away from the global median (a starved
+    # tenant class would show up as a hot bucket)
+    means = {b: sum(v) / len(v) for b, v in waits_by_bucket.items()}
+    worst = max(means.values())
+    _check(worst <= max(4.0 * p50, p99, 2.0),
+           f"replicas: unfair bucket mean {worst:.2f}s vs p50 {p50:.2f}s "
+           f"(means {means})")
+    print(f"  replicas: {n_jobs} jobs / {len({json.loads(p.read_text()).get('tenant') for p in done_msgs})} "
+          f"tenants over {n_replicas} replicas, r0 killed mid-sweep; "
+          f"drain {drain_s:.1f}s, queue-wait p50 {p50:.2f}s p99 {p99:.2f}s, "
+          f"worst bucket mean {worst:.2f}s")
+
+
 # ------------------------------------------------------------------- driver
 def run_sweep(work: Path, smoke: bool = False) -> int:
     work.mkdir(parents=True, exist_ok=True)
@@ -464,6 +600,7 @@ def run_sweep(work: Path, smoke: bool = False) -> int:
         h.shutdown()
     if not smoke:
         mix_breaker(work, fx)
+        mix_replicas(work)
     print(f"load sweep OK ({time.time() - t0:.1f}s)")
     return 0
 

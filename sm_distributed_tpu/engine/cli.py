@@ -135,6 +135,12 @@ def cmd_serve(args) -> int:
         overrides["http_port"] = args.port
     if args.host is not None:
         overrides["http_host"] = args.host
+    if args.replica_id is not None:
+        overrides["replica_id"] = args.replica_id
+    if args.replicas is not None:
+        overrides["replicas"] = args.replicas
+    if args.shards is not None:
+        overrides["spool_shards"] = args.shards
     if overrides:
         sm_config = dataclasses.replace(
             sm_config,
@@ -209,6 +215,16 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--host", default=None, help="override service.http_host")
     srv.add_argument("--port", type=int, default=None,
                      help="override service.http_port (0 = ephemeral)")
+    srv.add_argument("--replica-id", default=None,
+                     help="this scheduler replica's identity (default r0); "
+                          "run N processes with distinct ids over ONE spool "
+                          "to scale out (docs/SERVICE.md 'Replication model')")
+    srv.add_argument("--replicas", type=int, default=None,
+                     help="expected replica count (informational; the live "
+                          "set comes from registry heartbeats)")
+    srv.add_argument("--shards", type=int, default=None,
+                     help="override service.spool_shards (logical spool "
+                          "partitions; must match across replicas)")
     srv.add_argument("--no-api", action="store_true",
                      help="run the scheduler without the admin API")
     srv.add_argument("--max-jobs", type=int, default=None,

@@ -50,17 +50,36 @@ FP_HEARTBEAT = register_failpoint(
     "spool.heartbeat", "inside a claim's heartbeat touch (I/O error)")
 
 
-def sweep_orphan_tmp(queue_root: Path, max_age_s: float = 300.0) -> int:
+def sweep_orphan_tmp(queue_root: Path, max_age_s: float = 300.0,
+                     shards: "set[int] | None" = None,
+                     total_shards: int = 0) -> int:
     """Remove orphaned publish/retry tmp files from ``pending/``.
 
     A crash between a tmp write and its ``os.replace`` (publisher's
     ``.{msg_id}.tmp``, scheduler retry's ``.{msg_id}.json.tmp``) leaks the
     hidden tmp forever — no ``*.json`` glob ever sees it.  Age-gated so a
     publish that is in flight RIGHT NOW is never swept; crash-recovery
-    callers that know the writers are dead pass ``max_age_s=0``."""
+    callers that know the writers are dead pass ``max_age_s=0``.
+
+    Multi-replica scoping (ISSUE 8 satellite): with ``shards`` +
+    ``total_shards`` set, only tmp files whose message id hashes into one
+    of the given shards are touched — a takeover replica sweeps the dead
+    peer's partitions without reaping a LIVE peer's in-flight retry tmp
+    in a shard it doesn't own."""
     n = 0
     now = time.time()
     for p in (Path(queue_root) / "pending").glob(".*.tmp"):
+        if shards is not None and total_shards > 1:
+            # tmp names are ".{msg_id}.tmp" or ".{msg_id}.json.tmp"
+            msg_id = p.name[1:]
+            for suffix in (".json.tmp", ".tmp"):
+                if msg_id.endswith(suffix):
+                    msg_id = msg_id[: -len(suffix)]
+                    break
+            from ..service.leases import shard_of
+
+            if shard_of(msg_id, total_shards) not in shards:
+                continue
         try:
             if now - p.stat().st_mtime >= max_age_s:
                 p.unlink()
@@ -101,12 +120,23 @@ def clear_heartbeat(msg_path: Path) -> None:
 class ClaimHeartbeat(threading.Thread):
     """Background thread touching a claimed message's heartbeat file every
     ``interval_s`` while its job runs, so ``requeue_stale()`` can tell a slow
-    job (live heartbeat) from a crashed claim (dead/absent heartbeat)."""
+    job (live heartbeat) from a crashed claim (dead/absent heartbeat).
 
-    def __init__(self, msg_path: Path, interval_s: float = 5.0):
+    Multi-replica mode (ISSUE 8): the scheduler hands every beat a fenced
+    lease to renew too.  A renewal that discovers the lease LOST — a peer
+    fenced this holder out after its beats went stale — fires ``on_lost``
+    once, so the owning attempt can be cancelled early instead of running
+    to completion only to have its commit rejected."""
+
+    def __init__(self, msg_path: Path, interval_s: float = 5.0,
+                 lease=None, lease_store=None, on_lost=None):
         super().__init__(daemon=True, name=f"hb-{msg_path.stem}")
         self.msg_path = Path(msg_path)
         self.interval_s = interval_s
+        self.lease = lease
+        self.lease_store = lease_store
+        self.on_lost = on_lost
+        self._lost_fired = False
         # NB: name must not collide with threading.Thread's internal _stop
         self._halt = threading.Event()
 
@@ -116,6 +146,20 @@ class ClaimHeartbeat(threading.Thread):
                 touch_heartbeat(self.msg_path)
             except OSError:
                 pass                  # message already moved to a terminal dir
+            if self.lease is not None and self.lease_store is not None \
+                    and not self._lost_fired:
+                try:
+                    alive = self.lease_store.renew(self.lease)
+                except OSError:
+                    alive = True      # renewal I/O fault: claim survives
+                if not alive:
+                    self._lost_fired = True
+                    if self.on_lost is not None:
+                        try:
+                            self.on_lost()
+                        except Exception:
+                            logger.warning("claim heartbeat: on_lost failed",
+                                           exc_info=True)
             self._halt.wait(self.interval_s)
 
     def stop(self) -> None:
@@ -289,6 +333,10 @@ def annotate_callback(sm_config: SMConfig, residency=None):
             # cooperative cancellation: the job checks this at phase and
             # checkpoint-group boundaries (utils/cancel.py)
             cancel=getattr(ctx, "cancel", None),
+            # fenced-lease gate (service/leases.py): checked before the
+            # result store and the ledger commit, so a replica fenced out
+            # by a peer takeover never double-commits
+            fence=getattr(ctx, "fence", None),
         )
         # the scheduler's attempt-span context (already ambient when the
         # scheduler ran this in an _Attempt thread; attached here too so the

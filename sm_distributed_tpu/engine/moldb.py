@@ -68,13 +68,17 @@ class MolecularDB:
                 for r in reader
                 if (r.get(sf_col) or "").strip()
             ]
-        cur = self._conn.execute(
+        # no RETURNING: the image's sqlite predates 3.35, so upsert then
+        # select the row id in two statements (same transaction)
+        self._conn.execute(
             "INSERT INTO formula_db(name, version) VALUES(?,?) "
-            "ON CONFLICT(name, version) DO UPDATE SET name=excluded.name "
-            "RETURNING id",
+            "ON CONFLICT(name, version) DO NOTHING",
             (name, version),
         )
-        db_id = cur.fetchone()[0]
+        db_id = self._conn.execute(
+            "SELECT id FROM formula_db WHERE name=? AND version=?",
+            (name, version),
+        ).fetchone()[0]
         self._conn.execute("DELETE FROM molecule WHERE db_id=?", (db_id,))
         self._conn.executemany(
             "INSERT INTO molecule(db_id, mol_id, mol_name, sf) VALUES(?,?,?,?)",

@@ -44,6 +44,7 @@ class SearchJob:
         residency=None,
         device_token=None,
         cancel=None,
+        fence=None,
     ):
         self.ds_id = ds_id
         self.ds_name = ds_name
@@ -70,6 +71,12 @@ class SearchJob:
         # the search, so a timed-out/cancelled job releases the device
         # token and stores no partial results
         self.cancel = cancel
+        # multi-replica fence gate (service/leases.py): a callable raising
+        # FenceRejectedError when a peer replica fenced this job's claim
+        # out.  Checked immediately before results become durable and
+        # before the ledger commit — the two writes that would otherwise
+        # double-complete under a split-brain takeover.
+        self.fence = fence
         self.ledger = JobLedger(self.sm_config.storage.results_dir)
         # generation stats of the last completed run (workers, patterns/s,
         # device flag) — read by probes/benches (scripts/cold_path_bench.py)
@@ -186,6 +193,11 @@ class SearchJob:
                     # last cooperative gate before results become durable: a
                     # cancelled/expired job must store NOTHING partial
                     self.cancel.check("store_results")
+                if self.fence is not None:
+                    # last FENCE gate before results become durable: a claim
+                    # lost to a peer takeover must store NOTHING (the peer's
+                    # rerun owns the results now)
+                    self.fence()
                 with phase_timer("store_results", bundle.timings):
                     ion_mzs = {
                         (table_sf, table_ad): mz
@@ -207,6 +219,10 @@ class SearchJob:
                 self.last_hbm = devicemem.hbm_summary()
                 if self.last_hbm.get("hbm_peak_bytes") is not None:
                     tracing.event("hbm_job_peak", **self.last_hbm)
+            if self.fence is not None:
+                # ledger-commit fence: a stale replica must not flip the
+                # job row FINISHED under the takeover replica's run
+                self.fence()
             self.ledger.finish_job(job_id)
             if search.last_checkpoint is not None:
                 # only after results are durably persisted: a storage failure

@@ -148,18 +148,35 @@ class JobLedger:
         return row[0] if row else None
 
     def fail_stale_started(self, ds_id: str | None = None,
-                           error: str = "orphaned by process crash") -> int:
+                           error: str = "orphaned by process crash",
+                           ds_ids=None, before: float | None = None) -> int:
         """Crash reconciliation: mark STARTED job rows FAILED.  A row stuck in
         STARTED means the owning process died between start_job and its
         terminal update — rerunning is idempotent, but the ledger must not
-        report a dead job as live forever.  Only call when no other process
-        can legitimately own a STARTED row (single-daemon recovery, chaos
-        sweeps); with ``ds_id`` the sweep is scoped to one dataset."""
+        report a dead job as live forever.  With ``ds_id`` the sweep is
+        scoped to one dataset.
+
+        Multi-replica scoping (ISSUE 8 satellite): a takeover replica must
+        not reap a LIVE peer's in-flight rows.  ``ds_ids`` restricts the
+        sweep to the datasets whose spool messages the takeover actually
+        fenced + requeued (the dead replica's shard contents), and
+        ``before`` restricts it to rows started before the takeover
+        timestamp — a row a live peer started afterwards survives even if
+        its dataset collides."""
         q = "UPDATE job SET status=?, finished_at=?, error=? WHERE status=?"
         args: list = [JOB_FAILED, time.time(), error, JOB_STARTED]
         if ds_id is not None:
             q += " AND ds_id=?"
             args.append(ds_id)
+        if ds_ids is not None:
+            ids = sorted({str(d) for d in ds_ids})
+            if not ids:
+                return 0
+            q += f" AND ds_id IN ({','.join('?' * len(ids))})"
+            args.extend(ids)
+        if before is not None:
+            q += " AND started_at < ?"
+            args.append(float(before))
         cur = self._conn.execute(q, args)
         self._conn.commit()
         n = cur.rowcount if cur.rowcount and cur.rowcount > 0 else 0

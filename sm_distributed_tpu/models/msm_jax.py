@@ -889,12 +889,24 @@ class JaxBackend:
         if key not in recorded.get("keys", []):
             return False
         # the manifest promises the cache HELD these executables when it was
-        # written; an emptied cache dir (eviction, fresh checkout) voids it
-        cache_entries = sum(
+        # written; an emptied cache dir (eviction, fresh checkout) voids it.
+        # Exception: when the WRITE itself observed zero entries (XLA skips
+        # persisting compiles under jax_persistent_cache_min_compile_time —
+        # warm-process compiles of tiny fixtures finish in <1 s), the
+        # executables were never going to be on disk, and skipping the
+        # warmup executions is still correct: re-compiling them is exactly
+        # as cheap as it was when the manifest was written.
+        cache_entries = self._cache_entry_count()
+        recorded_entries = recorded.get("entries", {}).get(key)
+        if recorded_entries == 0:
+            return True
+        return cache_entries > 0
+
+    def _cache_entry_count(self) -> int:
+        return sum(
             1 for p in self._compile_cache.glob("*")
             if p.is_file() and not p.name.startswith(".")
             and p.suffix not in (".lock", ".tmp", ".json"))
-        return cache_entries > 0
 
     def _write_warmup_manifest(self, key: str | None) -> None:
         if key is None:
@@ -910,6 +922,12 @@ class JaxBackend:
         if key in recorded["keys"]:
             return
         recorded["keys"] = (recorded["keys"] + [key])[-64:]  # bounded
+        # entry count at write time: 0 records that XLA never persisted
+        # these (too-fast compiles), so a later hit must not demand entries
+        entries = dict(recorded.get("entries", {}))
+        entries[key] = self._cache_entry_count()
+        recorded["entries"] = {k: v for k, v in entries.items()
+                               if k in recorded["keys"]}
         tmp = path.with_name(path.name + ".tmp")
         try:
             path.parent.mkdir(parents=True, exist_ok=True)

@@ -66,9 +66,31 @@ class AdmissionController:
         self._depth = 0
         self._ewma: float | None = None
         self._shedding = False
+        # multi-replica gossip (ISSUE 8): a provider returning the alive
+        # PEERS' admission summaries (each the shape of ``stats()``), fed
+        # from the replica registry.  Admission state stays replica-local;
+        # quotas and shed decisions apply to the local + peer-reported
+        # APPROXIMATION of the global picture.
+        self._peer_view = None
         self.m_decisions = None
         if metrics is not None:
             self._init_metrics(metrics)
+
+    def set_peer_view(self, provider) -> None:
+        """Install the peer-summary provider (``JobScheduler.
+        peer_admission_summaries``).  ``None`` restores single-replica
+        behavior."""
+        self._peer_view = provider
+
+    def _peer_summaries(self) -> list[dict]:
+        if self._peer_view is None:
+            return []
+        try:
+            return [p for p in self._peer_view() if isinstance(p, dict)]
+        except Exception:
+            logger.warning("admission: peer view failed; using local state "
+                           "only", exc_info=True)
+            return []
 
     # -------------------------------------------------------------- metrics
     def _init_metrics(self, m) -> None:
@@ -98,22 +120,49 @@ class AdmissionController:
     def try_admit(self, tenant: str) -> Decision:
         """Reserve one slot for ``tenant`` (or shed).  The caller MUST
         follow up with ``confirm(msg_id, tenant)`` after a durable publish,
-        or ``abort(tenant)`` if publishing failed."""
+        or ``abort(tenant)`` if publishing failed.
+
+        With a peer view installed, the depth/quota/shed checks run
+        against the local + peer-reported GLOBAL estimate (with each
+        bound scaled by nothing — the bounds are cluster-wide), so N
+        replicas approximately enforce one shared quota without shared
+        state.  Peer numbers are one heartbeat old at worst; the
+        approximation errs by at most one beat's worth of admissions."""
         cfg = self.cfg
+        peers = self._peer_summaries()
+        peer_depth = sum(int(p.get("depth", 0)) for p in peers)
+        peer_tenant = sum(int((p.get("tenants") or {}).get(tenant, 0))
+                          for p in peers)
+        peer_ewmas = [float(p["latency_ewma_s"]) for p in peers
+                      if isinstance(p.get("latency_ewma_s"), (int, float))]
+        peer_shedding = any(p.get("shedding") for p in peers)
         with self._lock:
-            if self._shedding:
+            depth = self._depth + peer_depth
+            tenant_inflight = self._tenant_inflight.get(tenant, 0) + peer_tenant
+            shed_ewma = max([self._ewma or 0.0] + peer_ewmas)
+            if self._shedding or (
+                    peer_shedding and cfg.latency_shed_s > 0) or (
+                    cfg.latency_shed_s > 0
+                    and shed_ewma >= cfg.latency_shed_s
+                    and not self._shedding and peer_ewmas
+                    and shed_ewma > (self._ewma or 0.0)):
                 d = Decision(False, 503, "latency_overload", cfg.retry_after_s,
-                             f"job latency EWMA {self._ewma:.2f}s over the "
-                             f"{cfg.latency_shed_s:.2f}s shed threshold")
-            elif cfg.max_queue_depth and self._depth >= cfg.max_queue_depth:
+                             f"job latency EWMA {shed_ewma:.2f}s over the "
+                             f"{cfg.latency_shed_s:.2f}s shed threshold"
+                             + ("" if self._shedding else " (peer-reported)"))
+            elif cfg.max_queue_depth and depth >= cfg.max_queue_depth:
                 d = Decision(False, 429, "queue_full", cfg.retry_after_s,
-                             f"queue depth {self._depth} at the "
-                             f"{cfg.max_queue_depth} bound")
-            elif cfg.max_tenant_inflight and self._tenant_inflight.get(
-                    tenant, 0) >= cfg.max_tenant_inflight:
+                             f"queue depth {depth} at the "
+                             f"{cfg.max_queue_depth} bound"
+                             + (f" ({peer_depth} on peers)"
+                                if peer_depth else ""))
+            elif cfg.max_tenant_inflight and tenant_inflight >= \
+                    cfg.max_tenant_inflight:
                 d = Decision(False, 429, "tenant_quota", cfg.retry_after_s,
                              f"tenant {tenant!r} at its "
-                             f"{cfg.max_tenant_inflight} in-flight quota")
+                             f"{cfg.max_tenant_inflight} in-flight quota"
+                             + (f" ({peer_tenant} on peers)"
+                                if peer_tenant else ""))
             else:
                 self._depth += 1
                 self._tenant_inflight[tenant] = (
@@ -172,13 +221,20 @@ class AdmissionController:
                     self._ewma, cfg.effective_resume_s)
 
     # ---------------------------------------------------------------- state
-    def sync_from_spool(self, queue_root: str | Path) -> int:
+    def sync_from_spool(self, queue_root: str | Path,
+                        owns_msg=None) -> int:
         """Re-adopt the pending backlog after a restart so depth/quota
         tracking survives a service bounce.  Only ``pending/`` is adopted —
         running claims re-enter tracking when they terminate as unknown
-        no-ops, which errs on the permissive side."""
+        no-ops, which errs on the permissive side.
+
+        Multi-replica: ``owns_msg(msg_id)`` scopes adoption to this
+        replica's shards — peers adopt (and gossip) their own partitions,
+        so the global estimate counts each message once."""
         n = 0
         for p in sorted(Path(queue_root).glob("pending/*.json")):
+            if owns_msg is not None and not owns_msg(p.stem):
+                continue
             try:
                 msg = json.loads(p.read_text())
                 tenant = str(msg.get("tenant", "default")) \

@@ -161,6 +161,8 @@ class AdminAPI:
                     elif url.path == "/slo":
                         status, body = api._slo()
                         self._reply_json(status, body)
+                    elif url.path == "/peers":
+                        self._reply_json(200, api._peers())
                     elif (parts := url.path.strip("/").split("/"))[0] == \
                             "jobs" and len(parts) == 3 and parts[2] == "trace":
                         q = parse_qs(url.query)
@@ -352,6 +354,14 @@ class AdminAPI:
             "n": len(samples),
             "samples": samples,
         }
+
+    def _peers(self) -> dict:
+        """``GET /peers`` — the replica registry view (ISSUE 8): this
+        replica's identity/shards plus every peer's last heartbeat, shard
+        ownership, and gossiped admission summary.  Replicas poll each
+        other's registries through the shared spool; this endpoint gives
+        operators (and cross-node pollers) the same picture over HTTP."""
+        return self.service.scheduler.peers()
 
     def _slo(self) -> tuple[int, dict]:
         """``GET /slo`` — objective / attainment / error-budget burn per

@@ -74,9 +74,17 @@ from ..engine.daemon import (
 from ..utils import tracing
 from ..utils.cancel import CancelToken, DeadlineExceededError, JobCancelledError
 from ..utils.config import ServiceConfig
-from ..utils.failpoints import failpoint, register_failpoint
+from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
 from .device_pool import DevicePool, resolve_pool_size
+from .leases import (
+    FP_TAKEOVER_SCAN,
+    FenceRejectedError,
+    LeaseStore,
+    ReplicaRegistry,
+    owned_shards,
+    shard_of,
+)
 
 FP_RETRY_PUBLISH = register_failpoint(
     "sched.retry_publish",
@@ -175,6 +183,11 @@ class JobContext:
     # cooperative cancellation: callbacks check this at phase / checkpoint-
     # group boundaries (utils/cancel.CancelToken; None for legacy callers)
     cancel: object = field(repr=False, default=None)
+    # fence gate (service/leases.py, ISSUE 8): callbacks call this before
+    # durable side effects (result store, ledger commit); it raises
+    # FenceRejectedError when a peer replica fenced this claim out, so a
+    # stale replica can never double-commit.  None for legacy callers.
+    fence: object = field(repr=False, default=None)
     # end-to-end tracing (utils/tracing.TraceContext for THIS attempt's
     # span): callbacks attach it so every phase/batch span lands in the
     # job's trace; None for legacy callers
@@ -271,6 +284,21 @@ class JobScheduler:
             DevicePool(resolve_pool_size(self.cfg),
                        max_bypass=self.cfg.device_pool_max_bypass)
         self.device_token = self.device_pool
+        # multi-replica protocol (ISSUE 8, service/leases.py): this
+        # replica's identity in the registry, its epoch-numbered fenced
+        # leases, and the logical shard partition it claims from.  With
+        # replicas=1 and no peer heartbeats this degenerates to the old
+        # single-owner behavior (the replica owns every shard).
+        self.replica_id = self.cfg.replica_id
+        self.registry = ReplicaRegistry(
+            self.root, self.replica_id,
+            stale_after_s=self.cfg.replica_stale_after_s)
+        self.epoch = self.registry.register()
+        self.leases = LeaseStore(self.root, self.replica_id,
+                                 epoch=self.epoch, metrics=metrics)
+        self._lease_by_msg: dict[str, object] = {}
+        self._owned: set[int] = set(range(self.cfg.spool_shards))
+        self._fenced_count = 0
         self._records: dict[str, JobRecord] = {}
         self._records_lock = threading.Lock()
         # live attempts by msg_id: (CancelToken, _Attempt) — the seam the
@@ -315,12 +343,46 @@ class JobScheduler:
         # per-chip in_use gauge + grant/wait metrics (idempotent when the
         # service already attached them to the shared pool)
         self.device_pool.attach_metrics(m)
+        # replica-labeled families (ISSUE 8): identity, shard ownership,
+        # takeovers, fence rejections, peer liveness
+        self.m_replica_up = m.gauge(
+            "sm_replica_up", "1 while this replica is serving", ("replica",))
+        self.m_replica_up.labels(replica=self.replica_id).set(1)
+        self.m_shards_owned = m.gauge(
+            "sm_replica_shards_owned",
+            "Spool shards this replica currently owns", ("replica",))
+        self.m_takeover_requeues = m.counter(
+            "sm_replica_takeover_requeues_total",
+            "Stale peer claims fenced + requeued by this replica's takeover "
+            "scans", ("replica",))
+        self.m_replica_beats = m.counter(
+            "sm_replica_heartbeats_total",
+            "Registry heartbeats written", ("replica",))
+        self.m_fenced_claims = m.counter(
+            "sm_replica_fenced_claims_total",
+            "Local claims abandoned because a peer fenced them out",
+            ("replica",))
         m.add_collector(self._collect_queue_depths)
+        m.add_collector(self._collect_replicas)
 
     def _collect_queue_depths(self, m) -> None:
         g = m.gauge("sm_queue_depth", "Messages per spool state", ("state",))
         for s in _STATES:
             g.labels(state=s).set(len(list(self.root.glob(f"{s}/*.json"))))
+
+    def _collect_replicas(self, m) -> None:
+        peers = self.registry.peers()
+        m.gauge("sm_replica_peers_alive",
+                "Replicas with a fresh registry heartbeat (incl. self)").set(
+            sum(1 for p in peers if p.get("alive")))
+        age = m.gauge("sm_replica_peer_age_seconds",
+                      "Age of each replica's last registry heartbeat",
+                      ("replica",))
+        for p in peers:
+            age.labels(replica=str(p.get("replica_id", "?"))).set(
+                float(p.get("age_s", 0.0)))
+        self.m_shards_owned.labels(replica=self.replica_id).set(
+            len(self._owned))
 
     # ------------------------------------------------------------- records
     def _record(self, msg_id: str) -> JobRecord:
@@ -396,13 +458,71 @@ class JobScheduler:
             ds_id=rec.ds_id, attempts=rec.attempts,
             **({"error": rec.error[:500]} if rec.error else {}))
 
+    # ------------------------------------------------------------ replicas
+    def _recompute_owned(self) -> set[int]:
+        """Shards this replica owns right now: rendezvous hashing over the
+        alive replica set (self always included).  A dead peer's shards
+        land here the moment its heartbeat passes the staleness horizon."""
+        owned = owned_shards(self.replica_id, self.registry.alive(),
+                             self.cfg.spool_shards)
+        prev = self._owned
+        self._owned = owned
+        gained = owned - prev
+        if gained and prev != owned:
+            logger.info("replica %s: shard ownership now %s (+%s)",
+                        self.replica_id, sorted(owned), sorted(gained))
+        return owned
+
+    def owns_msg(self, msg_id: str) -> bool:
+        """Claim filter: does this replica's partition cover ``msg_id``?"""
+        return shard_of(msg_id, self.cfg.spool_shards) in self._owned
+
+    def _rescue_age_s(self) -> float:
+        """Liveness failsafe horizon: a message this old is claimable (or
+        requeueable) REGARDLESS of shard ownership.  Ownership is an
+        optimization — atomic renames + fences make cross-partition claims
+        safe — so a transient registry disagreement that leaves a shard
+        unowned can stall work at most this long."""
+        return max(5.0, 10.0 * self.cfg.stale_after_s)
+
+    def peers(self) -> dict:
+        """``GET /peers``: the replica registry view + this replica's
+        identity — what peers poll to approximate global admission."""
+        return {
+            "replica_id": self.replica_id,
+            "epoch": self.epoch,
+            "shards": self.cfg.spool_shards,
+            "owned": sorted(self._owned),
+            "fenced_claims": self._fenced_count,
+            "replicas": self.registry.peers(),
+        }
+
+    def peer_admission_summaries(self) -> list[dict]:
+        """Alive PEER replicas' admission summaries (excl. self) — the
+        AdmissionController folds these into its global estimates."""
+        return [p.get("admission", {}) | {"replica_id": p.get("replica_id")}
+                for p in self.registry.peers(include_self=False)
+                if p.get("alive") and isinstance(p.get("admission"), dict)]
+
     # ---------------------------------------------------------- dispatcher
     def _scan_pending(self, now: float) -> list[tuple[tuple, Path, dict]]:
-        """Eligible pending messages with their admission sort key."""
+        """Eligible pending messages with their admission sort key.  Only
+        messages in OWNED shards are read at all — the shard filter works
+        on the filename, so a replica never pays I/O for its peers'
+        partitions."""
         out = []
         with self._records_lock:
             inflight = dict(self._inflight_by_tenant)
+        rescue_age = self._rescue_age_s()
         for p in sorted(self.root.glob("pending/*.json")):
+            if shard_of(p.stem, self.cfg.spool_shards) not in self._owned:
+                # orphan rescue: an unowned message aging past the failsafe
+                # horizon gets claimed anyway (see _rescue_age_s)
+                try:
+                    if now - p.stat().st_mtime < rescue_age:
+                        continue
+                except FileNotFoundError:
+                    continue
             try:
                 msg = json.loads(p.read_text())
                 if not isinstance(msg, dict):
@@ -433,7 +553,11 @@ class JobScheduler:
 
     def _dispatch_loop(self) -> None:
         while not self._stop.is_set():
-            admitted = self._admit_one()
+            try:
+                admitted = self._admit_one()
+            except Exception:         # the dispatcher must never die
+                logger.error("scheduler: dispatcher error", exc_info=True)
+                admitted = False
             if not admitted:
                 self._stop.wait(self.cfg.poll_interval_s)
         self._drain_handoff()
@@ -447,6 +571,10 @@ class JobScheduler:
         attempt counter, and this is the evidence that breaks the cycle."""
         svc = dict(msg.get("service", {}))
         svc["claims"] = int(svc.get("claims", 0)) + 1
+        # queue-wait evidence for offline analysis (scripts/load_sweep.py's
+        # multi-replica mix reads it from drained messages)
+        svc["claimed_at"] = time.time()
+        svc["claimed_by"] = self.replica_id
         updated = {**msg, "service": svc}
         tmp = self.root / "pending" / f".{claimed.name}.tmp"
         try:
@@ -468,6 +596,10 @@ class JobScheduler:
             if claimed is None:
                 continue              # another scheduler/daemon won the race
             msg_id = claimed.stem
+            # the rename is the mutex; the lease is the fence.  Claiming
+            # bumps the fence past any prior holder's token, so a ghost
+            # replica that once held this message can no longer write.
+            lease = self.leases.claim(msg_id)
             if isinstance(msg, dict) and msg:
                 msg = self._bump_claims(claimed, msg)
                 claims = int(msg.get("service", {}).get("claims", 0))
@@ -486,11 +618,13 @@ class JobScheduler:
             ctx, _start = self._trace_ctx(msg_id, msg)
             rec.trace_id = ctx.trace_id
             tracing.event("claim", ctx=ctx, tenant=rec.tenant,
-                          attempts=rec.attempts,
+                          attempts=rec.attempts, replica=self.replica_id,
+                          fence=lease.fence,
                           claims=int(msg.get("service", {}).get("claims", 0)))
             with self._records_lock:
                 self._inflight_by_tenant[rec.tenant] = (
                     self._inflight_by_tenant.get(rec.tenant, 0) + 1)
+                self._lease_by_msg[msg_id] = lease
             # blocks when all workers are busy and the hand-off buffer is
             # full — natural admission backpressure
             while not self._stop.is_set():
@@ -514,6 +648,10 @@ class JobScheduler:
         with self._records_lock:
             t = rec.tenant
             self._inflight_by_tenant[t] = max(0, self._inflight_by_tenant.get(t, 1) - 1)
+            lease = self._lease_by_msg.pop(claimed.stem, None)
+        if lease is not None:
+            # holder cleared, fence KEPT: the next claim bumps past it
+            self.leases.release(lease)
         logger.info("scheduler: requeued claimed-but-unstarted %s", claimed.name)
 
     def _drain_handoff(self) -> None:
@@ -598,6 +736,10 @@ class JobScheduler:
                 self._terminal_deadline(claimed, msg, rec,
                                         "deadline exceeded before start")
                 return
+            if not self._fence_ok(rec, "attempt_start"):
+                # claimed-but-unstarted work fenced away while this worker
+                # was busy (or the process paused): never start the attempt
+                return
             if not isinstance(msg, dict) or not msg:
                 # poison message (unparseable JSON): dead-letter immediately,
                 # keeping the raw payload as evidence (daemon contract)
@@ -617,9 +759,21 @@ class JobScheduler:
             if self.metrics:
                 self.m_running.inc()
                 running_metric = True
-            hb = ClaimHeartbeat(claimed, interval_s=self.cfg.heartbeat_interval_s)
-            hb.start()
             token = CancelToken(deadline_at or None)
+            with self._records_lock:
+                claim_lease = self._lease_by_msg.get(msg_id)
+            # the claim heartbeat renews the fenced lease too; a renewal
+            # that discovers the lease LOST (a peer takeover fenced us out)
+            # cancels the attempt early — no point finishing work whose
+            # commit will be rejected
+            hb = ClaimHeartbeat(
+                claimed, interval_s=self.cfg.heartbeat_interval_s,
+                lease=claim_lease, lease_store=self.leases,
+                on_lost=lambda: (
+                    rec.state == "running"
+                    and self._deliver_cancel(
+                        token, rec, "fenced: lease lost to a peer takeover")))
+            hb.start()
             root, _start = self._trace_ctx(msg_id, msg)
             rec.trace_id = root.trace_id
             if self.slo is not None:
@@ -637,7 +791,10 @@ class JobScheduler:
             ctx = JobContext(msg_id=msg_id, attempt=rec.attempts,
                              device_token=lease,
                              metrics=self.metrics, cancel=token,
-                             trace=attempt_trace)
+                             trace=attempt_trace,
+                             fence=(None if claim_lease is None else
+                                    (lambda _l=claim_lease:
+                                     self.leases.check(_l))))
             attempt = _Attempt(self.callback, msg, ctx, self._cb_takes_ctx)
             with self._records_lock:
                 self._live[msg_id] = (token, attempt)
@@ -684,7 +841,15 @@ class JobScheduler:
             if timed_out and self.metrics and not token.deadline_exceeded():
                 self.m_timeouts.inc()
             is_cancel_exc = isinstance(attempt.error, JobCancelledError)
-            if token.deadline_exceeded() or \
+            is_fence = isinstance(attempt.error, FenceRejectedError) or (
+                token.cancelled()
+                and str(token.reason or "").startswith("fenced"))
+            if is_fence:
+                # a peer fenced this claim out mid-attempt: every write is
+                # forfeit — the message (and its spool file) belongs to the
+                # takeover replica now
+                self._note_fenced(rec, token.reason or str(attempt.error))
+            elif token.deadline_exceeded() or \
                     isinstance(attempt.error, DeadlineExceededError):
                 err = token.reason or str(attempt.error)
                 self._terminal_deadline(
@@ -742,6 +907,7 @@ class JobScheduler:
         delivered = token.cancel(reason)
         kind = ("deadline" if reason.startswith("deadline") else
                 "stalled" if reason.startswith("stalled") else
+                "fenced" if reason.startswith("fenced") else
                 "user" if "user" in reason else "timeout")
         if delivered:
             with self._records_lock:
@@ -804,6 +970,7 @@ class JobScheduler:
         msg["error"] = reason
         msg["cancelled"] = True
         dst.write_text(json.dumps(msg, indent=2))
+        self.leases.clear(msg_id)
         rec = self._record(msg_id)
         rec.state = "cancelled"
         rec.error = reason
@@ -842,13 +1009,63 @@ class JobScheduler:
                         f"stalled: no progress for {stalled:.1f}s "
                         f"(last phase {token.progress_phase or 'unknown'})")
 
+    # ----------------------------------------------------------- fencing
+    def _fence_ok(self, rec: JobRecord, what: str) -> bool:
+        """The write gate (ISSUE 8): every spool-mutating outcome calls
+        this first.  False = a peer fenced this claim out; the caller must
+        abandon ALL writes (the bookkeeping is already done here)."""
+        with self._records_lock:
+            lease = self._lease_by_msg.get(rec.msg_id)
+        if lease is None:
+            return True               # legacy claim (no lease recorded)
+        try:
+            self.leases.check(lease)
+            return True
+        except FenceRejectedError as exc:
+            self._note_fenced(rec, f"{what}: {exc}")
+            return False
+
+    def _note_fenced(self, rec: JobRecord, why: str) -> None:
+        """A peer replica fenced this claim out.  Locally the claim is
+        finished business — free the admission slot, count it for
+        ``wait_for_terminal`` waiters, drop the trace root (the takeover
+        replica continues and closes the SAME trace) — but the spool,
+        results, and ledger are NOT touched: they belong to the new owner."""
+        why = str(why)
+        with self._records_lock:
+            self._lease_by_msg.pop(rec.msg_id, None)
+            self._trace_roots.pop(rec.msg_id, None)
+            self._fenced_count += 1
+            self._terminal_count += 1
+        rec.state = "queued"          # from this replica's view: back in line
+        rec.error = why if why.startswith("fenced") else f"fenced: {why}"
+        tracing.event("fence_reject", replica=self.replica_id,
+                      msg_id=rec.msg_id, why=why[:300])
+        if self.metrics:
+            self.m_fenced_claims.labels(replica=self.replica_id).inc()
+        if self.admission is not None:
+            self.admission.note_terminal(rec.msg_id)
+        logger.warning("scheduler[%s]: claim on %s fenced out — abandoning "
+                       "all writes (%s)", self.replica_id, rec.msg_id, why)
+
+    def _drop_lease(self, msg_id: str, terminal: bool) -> None:
+        with self._records_lock:
+            lease = self._lease_by_msg.pop(msg_id, None)
+        if terminal:
+            self.leases.clear(msg_id)
+        elif lease is not None:
+            self.leases.release(lease)
+
     # ----------------------------------------------------------- outcomes
     def _finish(self, claimed: Path, rec: JobRecord) -> None:
+        if not self._fence_ok(rec, "complete"):
+            return
         # same seam as the daemon consumer's: job succeeded, message not yet
         # in done/ — a crash here must reprocess idempotently, never lose it
         failpoint(FP_COMPLETE, path=claimed)
         os.replace(claimed, self.root / "done" / claimed.name)
         clear_heartbeat(claimed)
+        self._drop_lease(rec.msg_id, terminal=True)
         rec.state = "done"
         rec.finished_at = time.time()
         self._close_trace(rec, "done")
@@ -859,6 +1076,8 @@ class JobScheduler:
 
     def _handle_failure(self, claimed: Path, msg: dict, rec: JobRecord,
                         error: str, tb: str) -> None:
+        if not self._fence_ok(rec, "retry_republish"):
+            return
         max_attempts = self._job_max_attempts(msg)
         rec.error = error
         if rec.attempts >= max_attempts:
@@ -890,12 +1109,15 @@ class JobScheduler:
         os.replace(tmp, self.root / "pending" / claimed.name)
         claimed.unlink()
         clear_heartbeat(claimed)
+        self._drop_lease(rec.msg_id, terminal=False)
         logger.warning(
             "scheduler: %s attempt %d/%d failed (%s); retry in %.2fs",
             claimed.name, rec.attempts, max_attempts, error, delay)
 
     def _dead_letter(self, claimed: Path, msg: dict, rec: JobRecord,
                      error: str, tb: str) -> None:
+        if not self._fence_ok(rec, "dead_letter"):
+            return
         failed = dict(msg) if msg else {}
         failed["error"] = error
         if tb:
@@ -908,6 +1130,7 @@ class JobScheduler:
         except FileNotFoundError:
             pass
         clear_heartbeat(claimed)
+        self._drop_lease(rec.msg_id, terminal=True)
         rec.state = "failed"
         rec.error = error
         rec.finished_at = time.time()
@@ -922,6 +1145,8 @@ class JobScheduler:
                             error: str) -> None:
         """User cancel honored: the message is terminal (never retried),
         filed under failed/ with ``cancelled: true`` for the audit trail."""
+        if not self._fence_ok(rec, "terminal_cancel"):
+            return
         failed = dict(msg) if isinstance(msg, dict) and msg else {}
         failed["error"] = error
         failed["cancelled"] = True
@@ -933,6 +1158,7 @@ class JobScheduler:
         except FileNotFoundError:
             pass
         clear_heartbeat(claimed)
+        self._drop_lease(rec.msg_id, terminal=True)
         rec.state = "cancelled"
         rec.error = error
         rec.finished_at = time.time()
@@ -974,6 +1200,7 @@ class JobScheduler:
             json.dumps(q, indent=2))
         claimed.unlink()
         clear_heartbeat(claimed)
+        self._drop_lease(claimed.stem, terminal=True)
         rec.state = "quarantined"
         rec.error = reason
         rec.finished_at = time.time()
@@ -987,18 +1214,84 @@ class JobScheduler:
             self.m_quarantined.inc()
         logger.error("scheduler: %s %s", claimed.name, reason)
 
+    # ---------------------------------------------------------- replication
+    def _beat_summary(self) -> dict:
+        """What this replica gossips in its registry heartbeat: owned
+        shards + replica-local admission state, so peers (and ``GET
+        /peers``) can approximate global quotas and shed decisions."""
+        s: dict = {"owned": sorted(self._owned), "workers": self.cfg.workers,
+                   "fenced_claims": self._fenced_count}
+        if self.admission is not None:
+            s["admission"] = self.admission.stats()
+        return s
+
+    def _takeover_scan(self) -> None:
+        """One takeover pass: recompute shard ownership from the live
+        replica set, fence + requeue stale claims in owned shards, and
+        sweep orphaned tmp/lease debris — scoped so a LIVE peer's in-flight
+        work in shards we don't own is never reaped."""
+        failpoint(FP_TAKEOVER_SCAN)
+        owned = self._recompute_owned()
+        n = self._requeue_stale_owned(self.cfg.stale_after_s)
+        if n:
+            logger.info("replica %s: takeover requeued %d stale claim(s)",
+                        self.replica_id, n)
+        sweep_orphan_tmp(self.root, max_age_s=self.cfg.stale_after_s,
+                         shards=owned, total_shards=self.cfg.spool_shards)
+        self.leases.sweep_orphans(self.root,
+                                  max_age_s=self.cfg.stale_after_s)
+
+    def _replica_loop(self) -> None:
+        """Registry heartbeat + takeover scan in one thread.  Both fire
+        immediately on start (a restarted replica must re-announce itself
+        and adopt its shards before the first claim cycle), then on their
+        own cadences.  A beat/scan fault never kills the loop."""
+        next_beat = 0.0
+        next_scan = 0.0
+        tick = max(0.02, min(self.cfg.replica_heartbeat_interval_s,
+                             self.cfg.takeover_interval_s) / 4.0)
+        while not self._stop.is_set():
+            now = time.time()
+            if now >= next_beat:
+                try:
+                    self.registry.beat(summary=self._beat_summary())
+                    if self.metrics:
+                        self.m_replica_beats.labels(
+                            replica=self.replica_id).inc()
+                except OSError:
+                    logger.warning("replica %s: heartbeat write failed",
+                                   self.replica_id, exc_info=True)
+                next_beat = now + self.cfg.replica_heartbeat_interval_s
+            if now >= next_scan:
+                try:
+                    self._takeover_scan()
+                except OSError:
+                    logger.warning("replica %s: takeover scan failed",
+                                   self.replica_id, exc_info=True)
+                next_scan = now + self.cfg.takeover_interval_s
+            self._stop.wait(tick)
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
         if self._started:
             raise RuntimeError("scheduler already started")
         self._started = True
-        # crash recovery first: claims with dead heartbeats return to pending
+        self._recompute_owned()
+        # crash recovery first: claims with dead heartbeats in OWNED shards
+        # are fenced + returned to pending
         n = self.requeue_stale()
         if n:
             logger.info("scheduler: requeued %d stale claim(s) on startup", n)
         # orphaned publish/retry tmp files older than the staleness horizon
-        # can have no live writer — the crash that leaked them also killed it
-        sweep_orphan_tmp(self.root, max_age_s=self.cfg.stale_after_s)
+        # can have no live writer — the crash that leaked them also killed
+        # it; scoped to owned shards so peers' in-flight tmps survive
+        sweep_orphan_tmp(self.root, max_age_s=self.cfg.stale_after_s,
+                         shards=self._owned,
+                         total_shards=self.cfg.spool_shards)
+        r = threading.Thread(target=self._replica_loop, daemon=True,
+                             name=f"sched-replica-{self.replica_id}")
+        r.start()
+        self._threads.append(r)
         d = threading.Thread(target=self._dispatch_loop, daemon=True,
                              name="sched-dispatch")
         d.start()
@@ -1013,16 +1306,57 @@ class JobScheduler:
                                   name="sched-watchdog")
             wd.start()
             self._threads.append(wd)
-        logger.info("scheduler: started (%d workers, queue %s)",
-                    self.cfg.workers, self.root)
+        logger.info("scheduler: started (%d workers, queue %s, replica %s "
+                    "epoch %d, %d/%d shards)",
+                    self.cfg.workers, self.root, self.replica_id, self.epoch,
+                    len(self._owned), self.cfg.spool_shards)
 
     def requeue_stale(self) -> int:
-        """Heartbeat-aware crash recovery (delegates to the daemon's)."""
-        from ..engine.daemon import QueueConsumer
+        """Heartbeat-aware crash recovery, scoped to OWNED shards and
+        fence-bumped (ISSUE 8): dead claims return to pending/ with their
+        previous holder's token invalidated first."""
+        return self._requeue_stale_owned(self.cfg.stale_after_s)
 
-        consumer = QueueConsumer(self.root.parent, callback=None,
-                                 queue=self.root.name)
-        return consumer.requeue_stale(max_age_s=self.cfg.stale_after_s)
+    def _requeue_stale_owned(self, max_age_s: float) -> int:
+        from ..engine.daemon import heartbeat_path
+
+        n = 0
+        now = time.time()
+        rescue_age = self._rescue_age_s()
+        for p in self.root.glob("running/*.json"):
+            msg_id = p.stem
+            in_owned = shard_of(msg_id, self.cfg.spool_shards) in self._owned
+            with self._records_lock:
+                if msg_id in self._lease_by_msg:
+                    continue          # our own live claim
+            hb = heartbeat_path(p)
+            try:
+                ref = hb.stat().st_mtime if hb.exists() else p.stat().st_mtime
+            except FileNotFoundError:
+                continue              # finished between glob and stat
+            # freshest sign of life: claim heartbeat OR lease renewal
+            ref = max(ref, self.leases.renewed_at(msg_id))
+            if now - ref < max_age_s:
+                continue
+            if not in_owned and now - ref < rescue_age:
+                continue              # a peer's partition — not ours to reap
+                                      # unless it aged past the failsafe
+            # fence FIRST, move second: any write the dead (or merely
+            # silent) holder tries after this bump is rejected, so the
+            # requeue can never produce a double completion
+            self.leases.bump(msg_id)
+            try:
+                os.replace(p, self.root / "pending" / p.name)
+            except FileNotFoundError:
+                continue              # the holder finished in the window
+            clear_heartbeat(p)
+            n += 1
+            if self.metrics:
+                self.m_takeover_requeues.labels(
+                    replica=self.replica_id).inc()
+        if n:
+            record_recovery("replica.takeover_requeue", n)
+        return n
 
     def shutdown(self, timeout_s: float | None = None) -> bool:
         """Graceful drain: stop admission, requeue claimed-but-unstarted,
@@ -1036,6 +1370,11 @@ class JobScheduler:
             ok = ok and not t.is_alive()
         # belt and braces: anything still claimed (worker died mid-move)
         self._drain_handoff()
+        # drop out of the registry so peers adopt our shards immediately
+        # instead of waiting out the staleness horizon
+        self.registry.retire()
+        if self.metrics:
+            self.m_replica_up.labels(replica=self.replica_id).set(0)
         logger.info("scheduler: shutdown %s", "clean" if ok else "TIMED OUT")
         return ok
 

@@ -54,11 +54,15 @@ class AnnotationService:
                           ring_size=self.sm_config.tracing.ring_size)
         self.trace_dir = (self.sm_config.trace_dir
                           if self.sm_config.tracing.enabled else None)
+        # replica identity (ISSUE 8): stamped on every trace record and
+        # telemetry sample this process emits
+        tracing.set_replica(cfg.replica_id)
         # overload protection in front of /submit: bounded depth, per-tenant
         # quotas, EWMA latency shedding (service/admission.py); the
-        # scheduler feeds terminal outcomes + attempt latency back into it
+        # scheduler feeds terminal outcomes + attempt latency back into it.
+        # State is replica-local; the spool re-adoption and the peer view
+        # are wired after the scheduler exists (it owns the shard map).
         self.admission = AdmissionController(cfg.admission, metrics=self.metrics)
-        self.admission.sync_from_spool(self.queue_dir / queue)
         # SLO instrumentation (service/telemetry.py): queue-wait / first-
         # annotation / e2e histograms recorded at the scheduler's seams,
         # attainment served by GET /slo
@@ -75,6 +79,12 @@ class AnnotationService:
             queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
             admission=self.admission, trace_dir=self.trace_dir, slo=self.slo,
             device_pool=self.device_pool)
+        # replica-scoped spool re-adoption + the registry-backed peer view:
+        # each replica tracks its own shards and folds the peers' gossiped
+        # summaries into its quota/shed decisions (GET /peers serves both)
+        self.admission.sync_from_spool(self.queue_dir / queue,
+                                       owns_msg=self.scheduler.owns_msg)
+        self.admission.set_peer_view(self.scheduler.peer_admission_summaries)
         # device & memory telemetry: HBM/occupancy/cache sampler feeding
         # gauges + the GET /debug/timeseries snapshot ring
         from ..parallel.distributed import compile_cache_path
@@ -83,7 +93,8 @@ class AnnotationService:
             self.metrics, self.sm_config.telemetry,
             device_pool=self.device_pool,
             queue_root=self.queue_dir / queue,
-            compile_cache_dir=compile_cache_path(self.sm_config))
+            compile_cache_dir=compile_cache_path(self.sm_config),
+            replica_id=cfg.replica_id)
         # device-backend circuit breaker: configure the process singleton
         # from THIS service's knobs and export its state on /metrics
         get_device_breaker(cfg)

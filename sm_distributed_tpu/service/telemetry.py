@@ -55,9 +55,13 @@ class DeviceMonitor:
     def __init__(self, registry, cfg: TelemetryConfig | None = None,
                  device_token=None, queue_root: str | Path | None = None,
                  compile_cache_dir: str | Path | None = None,
-                 device_pool=None):
+                 device_pool=None, replica_id: str = ""):
         self.registry = registry
         self.cfg = cfg or TelemetryConfig()
+        # replica identity (ISSUE 8): stamped on every timeseries sample so
+        # a dashboard merging N replicas' /debug/timeseries can tell the
+        # streams apart
+        self.replica_id = replica_id
         # the scheduler's device pool (service/device_pool.py) — or, for
         # legacy callers, the old single TPU token (threading.Lock).  A
         # pool passed via ``device_token`` (the pool speaks the Lock
@@ -187,6 +191,7 @@ class DeviceMonitor:
 
         snap = {
             "ts": round(now, 3),
+            **({"replica": self.replica_id} if self.replica_id else {}),
             "devices": len(devices),
             "device_kind": devices[0]["kind"] if devices else None,
             "hbm_bytes_in_use": hbm_in_use,

@@ -255,6 +255,21 @@ class ServiceConfig:
                                          # queue (anti-starvation for
                                          # sub-mesh jobs under small-job
                                          # traffic)
+    # --- multi-replica scheduling (service/leases.py, ISSUE 8) ---
+    replica_id: str = "r0"               # this scheduler process's identity
+                                         # (serve --replica-id); leases and
+                                         # heartbeats carry it
+    replicas: int = 1                    # expected replica count (serve
+                                         # --replicas) — informational; the
+                                         # LIVE set comes from heartbeats
+    spool_shards: int = 8                # logical spool partitions; claims
+                                         # filter by crc32(msg_id) % shards
+                                         # and rendezvous-hash ownership
+    replica_heartbeat_interval_s: float = 2.0   # registry beat cadence
+    replica_stale_after_s: float = 8.0   # a peer whose beat is older drops
+                                         # from the alive set (its shards
+                                         # redistribute to survivors)
+    takeover_interval_s: float = 2.0     # takeover/orphan scan cadence
     # --- device-backend circuit breaker (models/breaker.py) ---
     breaker_threshold: int = 3           # consecutive device errors → open
     breaker_cooldown_s: float = 30.0     # open → half-open probe delay
@@ -279,6 +294,14 @@ class ServiceConfig:
             raise ValueError("service: device-pool knobs out of range "
                              "(device_pool_size >= 0, devices_per_job >= 1, "
                              "device_pool_max_bypass >= 0)")
+        if not self.replica_id or self.replicas <= 0 or self.spool_shards <= 0:
+            raise ValueError("service: replica_id must be non-empty and "
+                             "replicas/spool_shards positive")
+        if self.replica_heartbeat_interval_s <= 0 or \
+                self.replica_stale_after_s <= 0 or \
+                self.takeover_interval_s <= 0:
+            raise ValueError("service: replica heartbeat/staleness/takeover "
+                             "intervals must be positive")
 
 
 @dataclass(frozen=True)

@@ -168,6 +168,21 @@ def enabled() -> bool:
     return _enabled
 
 
+# replica identity (ISSUE 8): stamped on every record this process emits so
+# a trace continued across a takeover shows WHICH replica ran each span
+_replica_id = ""
+
+
+def set_replica(replica_id: str) -> None:
+    """Set the process-wide replica id (service startup; "" disables)."""
+    global _replica_id
+    _replica_id = str(replica_id or "")
+
+
+def replica() -> str:
+    return _replica_id
+
+
 # --------------------------------------------------------------- file sink
 # cached append handles: one flushed line per record, no per-record open()
 _files_lock = threading.Lock()
@@ -252,6 +267,8 @@ def _base(ctx: TraceContext, name: str, kind: str) -> dict:
     }
     if ctx.job_id:
         rec["job_id"] = ctx.job_id
+    if _replica_id:
+        rec["replica"] = _replica_id
     return rec
 
 
@@ -301,6 +318,8 @@ def emit_span(ctx: TraceContext, name: str, /, ts: float = 0.0,
     }
     if ctx.job_id:
         rec["job_id"] = ctx.job_id
+    if _replica_id:
+        rec["replica"] = _replica_id
     if attrs:
         rec["attrs"] = attrs
     _emit(rec, ctx.file)
