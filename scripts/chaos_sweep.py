@@ -191,6 +191,13 @@ SMOKE = ("ckpt.shard_write", "spool.complete", "storage.results_rename")
 # --------------------------------------------------------------- subcommands
 def cmd_consume_one(queue_dir: str, sm_config_path: str) -> int:
     """Drain one job through the real service scheduler (crashable)."""
+    # lock-order detection (ISSUE 9): the driver arms SM_LOCK_ORDER=raise,
+    # so every consumer child runs its scheduler/job stack instrumented —
+    # an acquisition-order cycle raises mid-job and fails the scenario.
+    # Enabled BEFORE the service imports so instance locks are in scope.
+    from sm_distributed_tpu.analysis import lockorder
+
+    lockorder.enable_from_env()
     from sm_distributed_tpu.engine.daemon import annotate_callback
     from sm_distributed_tpu.service.scheduler import JobScheduler
     from sm_distributed_tpu.utils.config import SMConfig
@@ -215,6 +222,10 @@ def _sub_env(spec: str | None, extra: dict | None = None) -> dict:
     env.pop("SM_FAILPOINTS", None)
     if spec:
         env["SM_FAILPOINTS"] = spec
+    # children run the lock-order detector in raise mode (ISSUE 9): a
+    # cycle anywhere in the instrumented scheduler stack fails the
+    # scenario instead of lurking until a production interleaving
+    env.setdefault("SM_LOCK_ORDER", "raise")
     if extra:
         env.update(extra)
     return env
@@ -476,18 +487,23 @@ def run_sweep(work: Path, only: list[str] | None = None,
 
 # ---------------------------------------------------------------- doc check
 def check_docs(doc_path: Path | None = None) -> list[str]:
-    """Uniqueness is enforced at registration (duplicate register_failpoint
-    raises on import); here: every name documented + every name covered by a
-    scenario + every scenario name registered."""
-    doc_path = doc_path or REPO_ROOT / "docs" / "RECOVERY.md"
-    errs = []
+    """SUPERSEDED by the smlint ``failpoint-registry`` rule (ISSUE 9,
+    docs/ANALYSIS.md): documentation coverage, dead entries, and unresolved
+    call sites are now checked by the shared static implementation, which
+    this gate delegates to so the sweep CLI and ``scripts/smlint.py`` can
+    never disagree.  Kept here on top: the RUNTIME cross-check between the
+    imported failpoint registry and this module's scenario table (the
+    static rule only sees source text, not what actually registered)."""
+    from sm_distributed_tpu.analysis.core import Project, run_lint
+
+    proj = Project.load(REPO_ROOT, ["sm_distributed_tpu", "scripts"])
+    if doc_path is not None:
+        p = Path(doc_path)
+        proj.aux["docs/RECOVERY.md"] = p.read_text() if p.exists() else ""
+    result = run_lint(proj, only={"failpoint-registry"})
+    errs = [f.render() for f in result.new]
+    # runtime registry <-> scenario table cross-check
     registered = set(failpoints.registered_failpoints())
-    if not doc_path.exists():
-        return [f"missing {doc_path}"]
-    text = doc_path.read_text()
-    for name in sorted(registered):
-        if name not in text:
-            errs.append(f"failpoint {name} not documented in {doc_path.name}")
     primaries = {sc.primary for sc in SCENARIOS}
     for name in sorted(registered - primaries):
         errs.append(f"failpoint {name} has no chaos scenario")

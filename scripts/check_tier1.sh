@@ -11,9 +11,11 @@
 #             one deadline job through the real service; ISSUE 4 satellite)
 #   --load    run only the load-sweep smoke gate after the test gate
 #
-# Always runs the failpoint registry gate first: registered names must be
-# unique (duplicate registration raises at import), documented in
-# docs/RECOVERY.md, and covered by a chaos scenario.  Then the isocalc
+# Always runs the smlint stage first (ISSUE 9): the static-analysis rule
+# set (docs/ANALYSIS.md) over the tree plus its --self-check (baseline
+# minimality + every rule's firing fixture).  Then the failpoint registry
+# gate: registered names must be unique (duplicate registration raises at
+# import), documented in docs/RECOVERY.md, and covered by a chaos scenario.  Then the isocalc
 # parallel smoke gate (scripts/isocalc_smoke.py): a 2-worker spheroid run
 # must produce byte-identical cache shards vs the serial run.  Then the
 # trace smoke gate (scripts/trace_smoke.py): a traced spheroid job through
@@ -43,8 +45,22 @@ trap 'rm -f "$LOG"' EXIT
 
 cd "$REPO_ROOT"
 
-# failpoint registry gate (fast, catches undocumented/uncovered failpoints —
-# including the ISSUE 3 isocalc.* seams)
+# smlint stage (ISSUE 9, always on): project-invariant static analysis —
+# fence-gated write seams, failpoint registry, metric conventions, config
+# drift, guarded-by locking, exception hygiene — must report zero NEW
+# findings, and --self-check proves the committed suppression baseline is
+# minimal and every rule's firing fixture still fires
+if ! env JAX_PLATFORMS=cpu python scripts/smlint.py; then
+    echo "check_tier1: FAIL — smlint found new findings" >&2
+    exit 1
+fi
+if ! env JAX_PLATFORMS=cpu python scripts/smlint.py --self-check; then
+    echo "check_tier1: FAIL — smlint self-check failed" >&2
+    exit 1
+fi
+
+# failpoint registry gate (now DELEGATES to the smlint failpoint-registry
+# rule + the runtime scenario-table cross-check the static rule can't see)
 if ! env JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --check-docs; then
     echo "check_tier1: FAIL — failpoint registry check failed" >&2
     exit 1

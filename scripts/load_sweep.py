@@ -584,23 +584,37 @@ def mix_replicas(base: Path, n_jobs: int = 600, tenant_space: int = 10_000,
 
 # ------------------------------------------------------------------- driver
 def run_sweep(work: Path, smoke: bool = False) -> int:
+    # lock-order detection (ISSUE 9): instrument every lock the service
+    # stack creates below and fail the sweep on an acquisition-order cycle
+    # — the load mixes drive scheduler workers, dispatcher, watchdog,
+    # admission, device pool, and telemetry concurrently, which is exactly
+    # the thread population a lurking inversion needs
+    from sm_distributed_tpu.analysis import lockorder
+
+    lockorder.enable()
     work.mkdir(parents=True, exist_ok=True)
     fx = build_fixtures(work)
     t0 = time.time()
-    h = Harness(work, "main")
     try:
-        print(f"load sweep ({'smoke' if smoke else 'full'}) at {h.base}")
-        mix_burst(h, fx, n_submit=(12 if smoke else 24))
+        h = Harness(work, "main")
+        try:
+            print(f"load sweep ({'smoke' if smoke else 'full'}) at {h.base}")
+            mix_burst(h, fx, n_submit=(12 if smoke else 24))
+            if not smoke:
+                mix_sustained(h, fx, n_submit=10, gap_s=0.1)
+                mix_cancel(h, fx)
+            mix_deadline(h, fx)
+            mix_poison(h, fx)
+        finally:
+            h.shutdown()
         if not smoke:
-            mix_sustained(h, fx, n_submit=10, gap_s=0.1)
-            mix_cancel(h, fx)
-        mix_deadline(h, fx)
-        mix_poison(h, fx)
+            mix_breaker(work, fx)
+            mix_replicas(work)
+        rep = lockorder.assert_no_cycles("load sweep")
+        print(f"lock-order: no cycles ({rep['locks_instrumented']} locks, "
+              f"{rep['edges']} order edges observed)")
     finally:
-        h.shutdown()
-    if not smoke:
-        mix_breaker(work, fx)
-        mix_replicas(work)
+        lockorder.disable()
     print(f"load sweep OK ({time.time() - t0:.1f}s)")
     return 0
 

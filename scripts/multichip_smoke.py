@@ -105,6 +105,13 @@ def _numpy_oracle(h: Harness, fx: dict):
 def run(work: Path) -> int:
     if len(jax.devices()) < N_DEVICES:
         return fail(f"virtual mesh failed: {len(jax.devices())} devices")
+    # lock-order detection (ISSUE 9): the multi-chip overlap scenario is
+    # the densest lock population in the tree (pool cond + scheduler maps
+    # + admission + metrics + telemetry, two jobs on distinct chips) —
+    # instrument everything built below and fail on a cycle at the end
+    from sm_distributed_tpu.analysis import lockorder
+
+    lockorder.enable()
     fx = build_fixtures(work)
     h = Harness(work, "multichip_smoke", sm_overrides={
         "backend": "jax_tpu",
@@ -208,9 +215,16 @@ def run(work: Path) -> int:
             return fail("/debug/timeseries lacks device_pool_ratio")
         print("multichip_smoke: pool drained; per-chip + pool-wide "
               "occupancy on /metrics and /debug/timeseries")
+
+        # ---- 4. lock-order graph over the whole smoke is acyclic ---------
+        rep = lockorder.assert_no_cycles("multichip_smoke")
+        print(f"multichip_smoke: lock-order clean "
+              f"({rep['locks_instrumented']} locks, {rep['edges']} order "
+              f"edges observed)")
         return 0
     finally:
         h.shutdown()
+        lockorder.disable()
 
 
 def main() -> int:

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""smlint — project-invariant static analysis for the sm-tpu tree.
+
+Runs the rule set in ``sm_distributed_tpu/analysis`` (docs/ANALYSIS.md has
+the catalog) over the engine + scripts and exits nonzero on any NEW
+finding — one not covered by the committed suppression baseline
+(``conf/smlint_baseline.json``) or an inline ``# smlint: ignore[rule]``.
+
+    python scripts/smlint.py                      # lint the default tree
+    python scripts/smlint.py sm_distributed_tpu   # lint one subtree
+    python scripts/smlint.py --json               # machine-readable report
+    python scripts/smlint.py --self-check         # baseline minimal + every
+                                                  # rule's fixture still fires
+    python scripts/smlint.py --write-baseline     # re-emit the baseline from
+                                                  # the current findings
+    python scripts/smlint.py --list-rules
+
+The ``--json`` report includes a ``sm_analysis_findings_total`` per-rule
+summary (total findings, INCLUDING baseline-suppressed ones) so
+perf_sentinel-style history diffing can flag rule-count regressions —
+a growing suppressed count is drift even while the gate stays green.
+
+Exit codes: 0 clean, 1 new findings (or self-check failure), 2 usage/IO.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from sm_distributed_tpu.analysis import core  # noqa: E402
+from sm_distributed_tpu.analysis.core import (  # noqa: E402
+    Project,
+    RULES,
+    load_baseline,
+    run_lint,
+    self_check,
+)
+
+DEFAULT_PATHS = ("sm_distributed_tpu", "scripts", "bench.py")
+DEFAULT_BASELINE = "conf/smlint_baseline.json"
+
+
+def _write_baseline(path: Path, result) -> None:
+    entries = []
+    seen = set()
+    for f in result.findings:
+        if f.key() in seen:
+            continue
+        seen.add(f.key())
+        entries.append({
+            "rule": f.rule, "path": f.path, "anchor": f.anchor,
+            "justification": "TODO: justify or fix "
+                             f"({f.message[:80]})",
+        })
+    path.write_text(json.dumps({
+        "__doc__": "smlint suppression baseline (docs/ANALYSIS.md). Every "
+                   "entry matches findings by (rule, path, anchor) and MUST "
+                   "carry a real justification; --self-check fails on "
+                   "entries matching zero findings.",
+        "suppressions": entries,
+    }, indent=2) + "\n")
+    print(f"smlint: wrote {len(entries)} suppression(s) to {path}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("paths", nargs="*", default=[],
+                    help=f"files/dirs to lint (default: {DEFAULT_PATHS})")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule subset to run")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--write-baseline", action="store_true")
+    ap.add_argument("--self-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    # importing rules registers the shipped set
+    from sm_distributed_tpu.analysis import rules as _rules  # noqa: F401
+
+    if args.list_rules:
+        for r in RULES.values():
+            print(f"{r.name:<22} {r.severity:<8} {r.doc.splitlines()[0]}")
+        return 0
+
+    try:
+        baseline = [] if args.no_baseline else load_baseline(
+            REPO_ROOT / args.baseline)
+    except (OSError, ValueError) as exc:
+        print(f"smlint: bad baseline {args.baseline}: {exc}", file=sys.stderr)
+        return 2
+
+    project = Project.load(REPO_ROOT, list(args.paths) or list(DEFAULT_PATHS))
+    only = set(args.rules.split(",")) if args.rules else None
+    unknown = (only or set()) - set(RULES)
+    if unknown:
+        print(f"smlint: unknown rule(s) {sorted(unknown)}", file=sys.stderr)
+        return 2
+    result = run_lint(project, baseline, only=only)
+
+    if args.write_baseline:
+        _write_baseline(REPO_ROOT / args.baseline, result)
+        return 0
+
+    errs = []
+    if args.self_check:
+        errs = self_check(project, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "paths": list(args.paths) or list(DEFAULT_PATHS),
+            "files": len(project.modules),
+            "new": [f.to_dict() for f in result.new],
+            "suppressed": [f.to_dict() for f in result.suppressed],
+            "self_check_errors": errs,
+            # the perf_sentinel-style history series: per-rule TOTALS
+            # (new + suppressed), so baseline growth is visible drift
+            "sm_analysis_findings_total": result.counts("all"),
+            "sm_analysis_new_findings_total": result.counts("new"),
+        }, indent=2))
+    else:
+        for f in result.new:
+            print(f.render())
+        for e in errs:
+            print(f"self-check: {e}", file=sys.stderr)
+        sup = f", {len(result.suppressed)} baseline-suppressed" \
+            if result.suppressed else ""
+        counts = ", ".join(f"{k}={v}" for k, v in
+                           result.counts("all").items()) or "none"
+        print(f"smlint: {'FAIL' if result.new or errs else 'OK'} — "
+              f"{len(result.new)} new finding(s){sup} across "
+              f"{len(project.modules)} file(s) [{counts}]")
+    return 1 if (result.new or errs) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

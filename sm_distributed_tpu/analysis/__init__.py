@@ -1,0 +1,34 @@
+"""Project-invariant static analysis (smlint) + runtime lock-order detection.
+
+ISSUE 9 tentpole.  PRs 1-8 accumulated cross-cutting invariants that were
+enforced only by reviewer memory: every spool/ledger write seam fenced,
+every failpoint documented and chaos-covered, every metric ``sm_``-prefixed
+and documented, every SMConfig knob mirrored into the template and docs,
+every shared attribute mutated under its declared lock, no exception
+swallowed silently.  The reference SM_distributed engine had exactly this
+failure mode — convention-only consistency between its Spark pipeline and
+its Postgres/ES bookkeeping — and multi-replica scale-out multiplies the
+cost of a miss: an unfenced write becomes a cross-replica double-commit, a
+lock-order cycle a fleet-wide deadlock.
+
+Two halves:
+
+- ``core`` + ``rules`` — a stdlib-``ast`` lint framework (rule registry,
+  per-rule severity, committed suppression baseline, per-rule firing
+  fixtures) behind the ``scripts/smlint.py`` CLI.  Docs: docs/ANALYSIS.md.
+- ``lockorder`` — opt-in runtime instrumentation of ``threading.Lock`` /
+  ``RLock`` / ``Condition`` ("tsan-lite") that records the lock
+  acquisition-order graph across scheduler / device-pool / admission /
+  metrics / telemetry threads and reports cycles, wired into the chaos and
+  load sweeps.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    Project,
+    Rule,
+    RULES,
+    load_baseline,
+    run_lint,
+    rule,
+)

@@ -1,0 +1,692 @@
+"""The shipped smlint rules (docs/ANALYSIS.md has the catalog).
+
+Every rule is a pure function over a parsed :class:`~.core.Project` and
+ships a firing + passing fixture (``--self-check`` re-proves both, so a
+rule that silently stops firing is itself a lint failure).
+
+Rules:
+
+- ``fence-gate``        — replicated write seams dominated by a fence guard
+- ``failpoint-registry``— failpoints registered, called, documented, chaos-covered
+- ``metrics-conventions``— ``sm_`` prefix, one kind per name, documented
+- ``config-drift``      — SMConfig knobs <-> template <-> docs, both ways
+- ``guarded-by``        — declared shared attrs mutated only under their lock
+- ``broad-except``      — no silent ``except Exception`` swallows
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+
+from .core import Finding, Project, rule
+
+# findings are created with rule/severity placeholders; core.Rule.run stamps
+# the registered values over them
+def _finding(mod, node, message: str) -> Finding:
+    return Finding("", "", mod.path, getattr(node, "lineno", 0), message,
+                   anchor=mod.anchor(node))
+
+
+# ------------------------------------------------------------- AST helpers
+def _attr_chain(node: ast.AST) -> str:
+    """Dotted name of an attribute/name chain (``self.leases.check``), or
+    "" when the expression is not a plain chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_name(call: ast.Call) -> str:
+    """Terminal callee name: ``failpoint`` for both ``failpoint(...)`` and
+    ``x.failpoint(...)``."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _subtree_strs(node: ast.AST) -> set[str]:
+    return {n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """``X`` for an expression ``self.X``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+# =========================================================== 1. fence-gate
+# the fenced write seams, anchored on their failpoint constants (PR 2/8
+# placed a failpoint at exactly these seams, so the anchor cannot drift
+# away from the write it marks)
+_FENCED_FAILPOINTS = {
+    "spool.complete": "spool complete (running/ -> done/)",
+    "sched.retry_publish": "retry republish into pending/",
+}
+# terminal-spool dirs whose writes are dead-letter/quarantine seams
+_TERMINAL_DIRS = ("failed", "quarantine")
+# storage-layer commits gated at their CALL SITE (the storage module itself
+# is the layer below the fence; its callers own the guard)
+_GATED_CALLS = ("finish_job",)
+_FENCE_GUARDS = ("fence", "_fence_ok")
+
+_FENCE_FIXTURE_FAIL = {
+    "sm_distributed_tpu/service/x.py": (
+        "from u import register_failpoint, failpoint\n"
+        "FP_C = register_failpoint('spool.complete', 'seam')\n"
+        "class S:\n"
+        "    def _finish(self, claimed):\n"
+        "        failpoint(FP_C, path=claimed)\n"
+        "        move(claimed)\n"
+        "    def _dead_letter(self, claimed):\n"
+        "        (self.root / 'failed' / claimed.name).write_text('x')\n"
+        "    def _commit(self):\n"
+        "        self.ledger.finish_job(1)\n"
+    ),
+}
+_FENCE_FIXTURE_PASS = {
+    "sm_distributed_tpu/service/x.py": (
+        "from u import register_failpoint, failpoint\n"
+        "FP_C = register_failpoint('spool.complete', 'seam')\n"
+        "class S:\n"
+        "    def _finish(self, claimed, rec):\n"
+        "        if not self._fence_ok(rec, 'complete'):\n"
+        "            return\n"
+        "        failpoint(FP_C, path=claimed)\n"
+        "        move(claimed)\n"
+        "    def _dead_letter(self, claimed, rec):\n"
+        "        if not self._fence_ok(rec, 'dead_letter'):\n"
+        "            return\n"
+        "        dst = self.root / 'failed' / claimed.name\n"
+        "        dst.write_text('x')\n"
+        "    def _commit(self):\n"
+        "        if self.fence is not None:\n"
+        "            self.fence()\n"
+        "        self.ledger.finish_job(1)\n"
+    ),
+}
+
+
+def _fp_const_map(project: Project) -> dict[str, str]:
+    """{constant name: failpoint name} from every
+    ``FP_X = register_failpoint("name", ...)`` assignment."""
+    out: dict[str, str] = {}
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    isinstance(node.value, ast.Call) and \
+                    _call_name(node.value) == "register_failpoint" and \
+                    node.value.args:
+                name = _const_str(node.value.args[0])
+                if name:
+                    out[node.targets[0].id] = name
+    return out
+
+
+@rule("fence-gate", severity="error",
+      doc="Replicated write seams (spool complete/republish, dead-letter/"
+          "quarantine writes, result store, ledger commit) must be "
+          "dominated by a fence guard (LeaseStore.check via _fence_ok or "
+          "a JobContext/SearchJob fence call) in the same function.",
+      fixture_fail=_FENCE_FIXTURE_FAIL, fixture_pass=_FENCE_FIXTURE_PASS)
+def fence_gate(project: Project):
+    fp_names = _fp_const_map(project)
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue                  # scripts/benches drive, they don't own
+                                      # replicated spool state
+        if mod.path.endswith("engine/storage.py"):
+            continue                  # the layer below the gate: its callers
+                                      # (SearchJob, scheduler) own the guard
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            guards: list[int] = []    # linenos of fence-guard calls
+            tainted: set[str] = set() # locals assigned from terminal-dir paths
+            seams: list[tuple[ast.AST, str]] = []
+            for node in ast.walk(fn):
+                if mod.enclosing_function(node) is not fn and node is not fn:
+                    continue          # skip nested defs/lambdas
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) and \
+                        _subtree_strs(node.value) & set(_TERMINAL_DIRS):
+                    tainted.add(node.targets[0].id)
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _call_name(node)
+                if callee in _FENCE_GUARDS:
+                    guards.append(node.lineno)
+                elif callee == "check" and isinstance(node.func, ast.Attribute) \
+                        and "leases" in _attr_chain(node.func):
+                    guards.append(node.lineno)
+                elif callee == "failpoint" and node.args and \
+                        isinstance(node.args[0], ast.Name):
+                    seam = _FENCED_FAILPOINTS.get(
+                        fp_names.get(node.args[0].id, ""))
+                    if seam:
+                        seams.append((node, seam))
+                elif callee == "write_text" and isinstance(node.func, ast.Attribute):
+                    recv = node.func.value
+                    hit = _subtree_strs(recv) & set(_TERMINAL_DIRS)
+                    if not hit and isinstance(recv, ast.Name) and \
+                            recv.id in tainted:
+                        hit = {"(tainted path)"}
+                    if hit:
+                        seams.append(
+                            (node, f"terminal-spool write ({sorted(hit)[0]})"))
+                elif callee == "replace" and \
+                        _attr_chain(node.func) == "os.replace" and any(
+                            _subtree_strs(a) & set(_TERMINAL_DIRS) or (
+                                isinstance(a, ast.Name) and a.id in tainted)
+                            for a in node.args):
+                    seams.append((node, "terminal-spool move"))
+                elif callee in _GATED_CALLS:
+                    seams.append((node, f"ledger commit ({callee})"))
+                elif callee == "store" and isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Attribute) and \
+                        node.func.value.attr == "store":
+                    seams.append((node, "result store (store.store)"))
+            for node, what in seams:
+                if not any(g <= node.lineno for g in guards):
+                    yield _finding(
+                        mod, node,
+                        f"{what} is not dominated by a fence guard "
+                        f"(_fence_ok / fence() / leases.check) in "
+                        f"{mod.qualname(node) or 'module scope'}")
+
+
+# ==================================================== 2. failpoint-registry
+_FPREG_FIXTURE_FAIL = {
+    "sm_distributed_tpu/x.py": (
+        "from u import register_failpoint, failpoint\n"
+        "FP_A = register_failpoint('seam.a', 'covered')\n"
+        "FP_DEAD = register_failpoint('seam.dead', 'never called')\n"
+        "def f(p):\n"
+        "    failpoint(FP_A, path=p)\n"
+        "    failpoint(FP_GHOST)\n"
+    ),
+    "aux": {"docs/RECOVERY.md": "only `seam.a` is documented here\n",
+            "scripts/chaos_sweep.py": "SCENARIOS = []\n"},
+}
+_FPREG_FIXTURE_PASS = {
+    "sm_distributed_tpu/x.py": (
+        "from u import register_failpoint, failpoint\n"
+        "FP_A = register_failpoint('seam.a', 'covered')\n"
+        "def f(p):\n"
+        "    failpoint(FP_A, path=p)\n"
+    ),
+    "aux": {"docs/RECOVERY.md": "`seam.a` does X\n",
+            "scripts/chaos_sweep.py": "Scenario('seam.a', ...)\n"},
+}
+
+
+@rule("failpoint-registry", severity="error",
+      doc="Every registered failpoint must have >=1 call site (no dead "
+          "entries), be documented in docs/RECOVERY.md, and be covered by "
+          "a chaos_sweep scenario; every failpoint() call site must "
+          "reference a registered constant.  Subsumes chaos_sweep "
+          "--check-docs.",
+      fixture_fail=_FPREG_FIXTURE_FAIL, fixture_pass=_FPREG_FIXTURE_PASS)
+def failpoint_registry(project: Project):
+    fp_names = _fp_const_map(project)
+    registered: dict[str, tuple] = {}   # name -> (mod, node)
+    called: set[str] = set()
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_name(node) == "register_failpoint" and node.args:
+                name = _const_str(node.args[0])
+                if not name:
+                    yield _finding(mod, node,
+                                   "register_failpoint name must be a "
+                                   "string literal")
+                elif name in registered:
+                    yield _finding(
+                        mod, node,
+                        f"failpoint {name!r} registered twice (also at "
+                        f"{registered[name][0].path}:"
+                        f"{registered[name][1].lineno})")
+                else:
+                    registered[name] = (mod, node)
+            elif _call_name(node) == "failpoint" and node.args and \
+                    mod.path != "sm_distributed_tpu/utils/failpoints.py":
+                arg = node.args[0]
+                name = _const_str(arg) or (
+                    fp_names.get(arg.id) if isinstance(arg, ast.Name)
+                    else None)
+                if name is None:
+                    yield _finding(
+                        mod, node,
+                        "failpoint() called with an argument that does not "
+                        "resolve to a register_failpoint constant")
+                else:
+                    called.add(name)
+    recovery = project.read("docs/RECOVERY.md") or ""
+    chaos_mod = project.module("scripts/chaos_sweep.py")
+    chaos_src = chaos_mod.source if chaos_mod else (
+        project.read("scripts/chaos_sweep.py") or "")
+    for name, (mod, node) in sorted(registered.items()):
+        if name not in called:
+            yield _finding(mod, node,
+                           f"failpoint {name!r} is registered but never "
+                           f"reached by a failpoint() call site (dead entry)")
+        if name not in recovery:
+            yield _finding(mod, node,
+                           f"failpoint {name!r} is not documented in "
+                           f"docs/RECOVERY.md")
+        if name not in chaos_src:
+            yield _finding(mod, node,
+                           f"failpoint {name!r} has no chaos_sweep scenario")
+
+
+# ================================================== 3. metrics-conventions
+_METRIC_KINDS = ("counter", "gauge", "histogram")
+_METRIC_NAME_RE = re.compile(r"^sm_[a-z0-9_]+$")
+_METRIC_DOCS = ("docs/OBSERVABILITY.md", "docs/SERVICE.md")
+
+_METRICS_FIXTURE_FAIL = {
+    "sm_distributed_tpu/x.py": (
+        "def f(m):\n"
+        "    m.counter('jobs_total', 'no prefix').inc()\n"
+        "    m.gauge('sm_thing', 'kind conflict').set(1)\n"
+        "    m.counter('sm_thing', 'kind conflict').inc()\n"
+        "    m.counter('sm_undocumented_total', 'not in docs').inc()\n"
+    ),
+    "aux": {"docs/OBSERVABILITY.md": "`sm_thing` is documented\n"},
+}
+_METRICS_FIXTURE_PASS = {
+    "sm_distributed_tpu/x.py": (
+        "def f(m):\n"
+        "    m.counter('sm_jobs_total', 'documented').inc()\n"
+    ),
+    "aux": {"docs/OBSERVABILITY.md": "`sm_jobs_total` counts jobs\n"},
+}
+
+
+@rule("metrics-conventions", severity="error",
+      doc="Every metric registered by literal name must be sm_-prefixed, "
+          "keep ONE kind (counter/gauge/histogram) across the tree, and be "
+          "documented in docs/OBSERVABILITY.md or docs/SERVICE.md.",
+      fixture_fail=_METRICS_FIXTURE_FAIL, fixture_pass=_METRICS_FIXTURE_PASS)
+def metrics_conventions(project: Project):
+    docs = project.doc_text(*_METRIC_DOCS)
+    seen: dict[str, tuple[str, object, object]] = {}  # name -> (kind, mod, node)
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and
+                    _call_name(node) in _METRIC_KINDS and node.args):
+                continue
+            name = _const_str(node.args[0])
+            if name is None:
+                continue              # dynamic names (registry internals)
+            kind = _call_name(node)
+            if not _METRIC_NAME_RE.match(name):
+                yield _finding(mod, node,
+                               f"metric {name!r} violates the sm_ naming "
+                               f"convention (^sm_[a-z0-9_]+$)")
+            prior = seen.get(name)
+            if prior is None:
+                seen[name] = (kind, mod, node)
+                if name not in docs:
+                    yield _finding(
+                        mod, node,
+                        f"metric {name!r} is not documented in "
+                        f"{' or '.join(_METRIC_DOCS)}")
+            elif prior[0] != kind:
+                yield _finding(
+                    mod, node,
+                    f"metric {name!r} registered as {kind} here but as "
+                    f"{prior[0]} at {prior[1].path}:{prior[2].lineno} — "
+                    f"one name, one kind")
+
+
+# ========================================================= 4. config-drift
+_CONFIG_MODULE = "utils/config.py"
+_TEMPLATES = {"SMConfig": "conf/config.json.template",
+              "DSConfig": "conf/ds_config.json.template"}
+
+_CONFIG_FIXTURE_FAIL = {
+    "sm_distributed_tpu/utils/config.py": (
+        "from dataclasses import dataclass, field\n"
+        "@dataclass\n"
+        "class SubConfig:\n"
+        "    knob_a: int = 1\n"
+        "@dataclass\n"
+        "class SMConfig:\n"
+        "    backend: str = 'x'\n"
+        "    missing_from_template: int = 0\n"
+        "    sub: SubConfig = field(default_factory=SubConfig)\n"
+    ),
+    "aux": {
+        "conf/config.json.template": json.dumps(
+            {"backend": "x", "sub": {"knob_a": 1, "ghost_key": 2}}),
+        "README.md": "backend knob_a ghost_key docs\n",
+    },
+}
+_CONFIG_FIXTURE_PASS = {
+    "sm_distributed_tpu/utils/config.py": (
+        "from dataclasses import dataclass, field\n"
+        "@dataclass\n"
+        "class SMConfig:\n"
+        "    backend: str = 'x'\n"
+    ),
+    "aux": {"conf/config.json.template": json.dumps({"backend": "x"}),
+            "README.md": "the backend knob is documented\n"},
+}
+
+
+def _dataclass_fields(mod) -> dict[str, list[tuple[str, str, int]]]:
+    """{ClassName: [(field, annotation_name, lineno)]} for @dataclass
+    classes (ClassVar and properties excluded)."""
+    out: dict[str, list[tuple[str, str, int]]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        if not any("dataclass" in _attr_chain(d) or (
+                isinstance(d, ast.Call) and "dataclass" in _attr_chain(d.func))
+                for d in node.decorator_list):
+            continue
+        fields = []
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.AnnAssign) and
+                    isinstance(stmt.target, ast.Name)):
+                continue
+            ann = stmt.annotation
+            ann_name = ann.id if isinstance(ann, ast.Name) else (
+                _const_str(ann) or "")
+            if "ClassVar" in ast.dump(ann):
+                continue
+            fields.append((stmt.target.id, ann_name.strip('"'), stmt.lineno))
+        out[node.name] = fields
+    return out
+
+
+def _knob_tree(classes: dict, cls: str, prefix: str = "") -> dict[str, int]:
+    """{dotted knob path: lineno}; nested dataclass fields recurse."""
+    out: dict[str, int] = {}
+    for name, ann, lineno in classes.get(cls, []):
+        ann = ann.strip("'\" ")
+        if ann in classes:
+            out.update(_knob_tree(classes, ann, prefix + name + "."))
+        else:
+            out[prefix + name] = lineno
+    return out
+
+
+def _template_keys(data: dict, prefix: str = "") -> set[str]:
+    out: set[str] = set()
+    for k, v in data.items():
+        if k.startswith("__"):
+            continue                  # template comment keys
+        if isinstance(v, dict):
+            out |= _template_keys(v, prefix + k + ".")
+        else:
+            out.add(prefix + k)
+    return out
+
+
+@rule("config-drift", severity="error",
+      doc="Every SMConfig/DSConfig knob must appear in its conf/*.template "
+          "and in the docs (docs/*.md or README), and every template key "
+          "must be a real knob.",
+      fixture_fail=_CONFIG_FIXTURE_FAIL, fixture_pass=_CONFIG_FIXTURE_PASS)
+def config_drift(project: Project):
+    mod = project.module(_CONFIG_MODULE)
+    if mod is None:
+        return
+    classes = _dataclass_fields(mod)
+    docs = [project.read("README.md") or ""]
+    if project.root is not None:
+        docs += [p.read_text() for p in sorted(
+            (project.root / "docs").glob("*.md"))]
+    docs += [v for k, v in project.aux.items()
+             if k.startswith("docs/") and k != "README.md"]
+    doc_text = "\n".join(docs)
+    for cls, tmpl_path in _TEMPLATES.items():
+        if cls not in classes:
+            continue
+        knobs = _knob_tree(classes, cls)
+        raw = project.read(tmpl_path)
+        if raw is None:
+            yield _finding(mod, mod.tree, f"missing template {tmpl_path}")
+            continue
+        tmpl = _template_keys(json.loads(raw))
+        for knob, lineno in sorted(knobs.items()):
+            if knob not in tmpl:
+                yield Finding("", "", mod.path, lineno,
+                              f"{cls} knob {knob!r} is missing from "
+                              f"{tmpl_path}", anchor=f"{cls}.{knob}")
+            leaf = knob.split(".")[-1]
+            if leaf not in doc_text:
+                yield Finding("", "", mod.path, lineno,
+                              f"{cls} knob {knob!r} is not documented "
+                              f"anywhere under docs/ or README.md",
+                              anchor=f"{cls}.{knob}.docs")
+        for key in sorted(tmpl - set(knobs)):
+            yield Finding("", "", mod.path, 0,
+                          f"{tmpl_path} key {key!r} is not a {cls} knob "
+                          f"(typo or removed config?)",
+                          anchor=f"{cls}.template.{key}")
+
+
+# ============================================================ 5. guarded-by
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+             "clear", "update", "add", "discard", "setdefault",
+             "move_to_end", "appendleft", "popleft", "sort", "reverse"}
+
+_GUARDED_FIXTURE_FAIL = {
+    "sm_distributed_tpu/x.py": (
+        "import threading\n"
+        "class C:\n"
+        "    _GUARDED_BY = {'_items': '_lock', '_count': '_lock'}\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n"
+        "        self._count = 0\n"
+        "    def bad(self, x):\n"
+        "        self._items.append(x)\n"
+        "        self._count += 1\n"
+    ),
+}
+_GUARDED_FIXTURE_PASS = {
+    "sm_distributed_tpu/x.py": (
+        "import threading\n"
+        "class C:\n"
+        "    _GUARDED_BY = {'_items': '_lock', '_count': '_lock'}\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._items = []\n"
+        "        self._count = 0\n"
+        "    def good(self, x):\n"
+        "        with self._lock:\n"
+        "            self._items.append(x)\n"
+        "            self._count += 1\n"
+        "    def _drain_locked(self):\n"
+        "        self._items.clear()\n"
+    ),
+}
+
+
+def _guarded_decls(cls: ast.ClassDef) -> dict[str, str]:
+    """The class's ``_GUARDED_BY = {attr: lock}`` declaration, if any."""
+    for stmt in cls.body:
+        targets = stmt.targets if isinstance(stmt, ast.Assign) else (
+            [stmt.target] if isinstance(stmt, ast.AnnAssign) else [])
+        if any(isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
+               for t in targets) and isinstance(
+                   getattr(stmt, "value", None), ast.Dict):
+            out = {}
+            for k, v in zip(stmt.value.keys, stmt.value.values):
+                ks, vs = _const_str(k), _const_str(v)
+                if ks and vs:
+                    out[ks] = vs
+            return out
+    return {}
+
+
+def _mutated_attr(node: ast.AST) -> str | None:
+    """``X`` when ``node`` mutates ``self.X``: assignment/augassign/del of
+    ``self.X`` (or a subscript of it), or a mutating method call on it."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Delete)):
+        targets = getattr(node, "targets", None) or \
+            [getattr(node, "target", None)]
+        for t in targets:
+            if t is None:
+                continue
+            base = t.value if isinstance(t, ast.Subscript) else t
+            attr = _self_attr(base)
+            if attr:
+                return attr
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATORS:
+        return _self_attr(node.func.value)
+    return None
+
+
+def _holds_lock(mod, node: ast.AST, lock: str) -> bool:
+    """Is ``node`` lexically inside ``with self.<lock>:``?"""
+    for anc in mod.ancestors(node):
+        if isinstance(anc, (ast.With, ast.AsyncWith)):
+            for item in anc.items:
+                if _self_attr(item.context_expr) == lock:
+                    return True
+    return False
+
+
+@rule("guarded-by", severity="error",
+      doc="Attributes declared in a class's _GUARDED_BY registry may only "
+          "be mutated inside `with self.<lock>:` — except in __init__ "
+          "(happens-before publication) and in methods named *_locked "
+          "(documented caller-holds-lock convention).",
+      fixture_fail=_GUARDED_FIXTURE_FAIL, fixture_pass=_GUARDED_FIXTURE_PASS)
+def guarded_by(project: Project):
+    for mod in project.modules:
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            decls = _guarded_decls(cls)
+            if not decls:
+                continue
+            for fn in cls.body:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                if fn.name == "__init__" or fn.name.endswith("_locked"):
+                    continue
+                for node in ast.walk(fn):
+                    attr = _mutated_attr(node)
+                    if attr is None or attr not in decls:
+                        continue
+                    lock = decls[attr]
+                    if not _holds_lock(mod, node, lock):
+                        yield _finding(
+                            mod, node,
+                            f"{cls.name}.{attr} is declared guarded by "
+                            f"self.{lock} but is mutated in {fn.name}() "
+                            f"without holding it")
+
+
+# ========================================================== 6. broad-except
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
+                "log", "write"}
+
+_BROAD_FIXTURE_FAIL = {
+    "sm_distributed_tpu/x.py": (
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        pass\n"
+        "    try:\n"
+        "        g()\n"
+        "    except:\n"
+        "        return None\n"
+    ),
+}
+_BROAD_FIXTURE_PASS = {
+    "sm_distributed_tpu/x.py": (
+        "from .logger import logger\n"
+        "def f():\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception:\n"
+        "        logger.warning('g failed', exc_info=True)\n"
+        "    try:\n"
+        "        g()\n"
+        "    except Exception as exc:\n"
+        "        record(exc)\n"
+        "        raise\n"
+        "    try:\n"
+        "        g()\n"
+        "    except (OSError, ValueError):\n"
+        "        pass\n"
+    ),
+}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                   # bare except:
+    names = [t] if not isinstance(t, ast.Tuple) else list(t.elts)
+    return any(isinstance(n, ast.Name) and
+               n.id in ("Exception", "BaseException") for n in names)
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the body neither re-raises, nor logs, nor uses the bound
+    exception (recording it somewhere counts as handling)."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Name) and handler.name and \
+                node.id == handler.name and isinstance(node.ctx, ast.Load):
+            return False
+        if isinstance(node, ast.Call):
+            callee = _call_name(node)
+            chain = _attr_chain(node.func)
+            if callee in _LOG_METHODS and ("logger" in chain or
+                                           "logging" in chain or
+                                           "stderr" in chain or
+                                           "stdout" in chain):
+                return False
+            if callee in ("record_recovery", "format_exc", "print_exc"):
+                return False
+    return True
+
+
+@rule("broad-except", severity="error",
+      doc="No `except Exception` / bare `except` that swallows silently: "
+          "the handler must re-raise, log, or use the caught exception — "
+          "or the except type must be narrowed.",
+      fixture_fail=_BROAD_FIXTURE_FAIL, fixture_pass=_BROAD_FIXTURE_PASS)
+def broad_except(project: Project):
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) and \
+                    _handler_swallows(node):
+                yield _finding(
+                    mod, node,
+                    "broad except swallows the exception without logging, "
+                    "re-raising, or recording it — narrow the type or add "
+                    "context (trace/job id) to a log line")

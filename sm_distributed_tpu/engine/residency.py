@@ -33,6 +33,10 @@ class _LRU:
     and the duplicate is dropped (device-backend builds don't race in
     practice because they run under the scheduler's TPU token)."""
 
+    # shared-state registry checked by the smlint guarded-by rule
+    # (docs/ANALYSIS.md): mutated only under _lock
+    _GUARDED_BY = {"data": "_lock", "hits": "_lock", "misses": "_lock"}
+
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
         self.data: OrderedDict = OrderedDict()

@@ -37,6 +37,11 @@ _STATE_CODE = {STATE_CLOSED: 0, STATE_HALF_OPEN: 1, STATE_OPEN: 2}
 class CircuitBreaker:
     """Consecutive-failure breaker with half-open recovery probes."""
 
+    # shared-state registry checked by the smlint guarded-by rule
+    # (docs/ANALYSIS.md): these attrs may only be mutated under _lock
+    _GUARDED_BY = {"_state": "_lock", "_failures": "_lock",
+                   "_opened_at": "_lock", "transitions": "_lock"}
+
     def __init__(self, threshold: int = 3, cooldown_s: float = 30.0):
         self.threshold = int(threshold)
         self.cooldown_s = float(cooldown_s)
@@ -52,8 +57,9 @@ class CircuitBreaker:
         with self._lock:
             return self._state
 
-    def _transition(self, to: str) -> None:
-        # callers hold self._lock
+    def _transition_locked(self, to: str) -> None:
+        # callers hold self._lock (the _locked suffix is the guarded-by
+        # rule's caller-holds-lock convention, docs/ANALYSIS.md)
         if self._state == to:
             return
         self.transitions.append((time.monotonic(), self._state, to))
@@ -76,7 +82,7 @@ class CircuitBreaker:
             if self._state == STATE_CLOSED or self._state == STATE_HALF_OPEN:
                 return True
             if time.monotonic() - self._opened_at >= self.cooldown_s:
-                self._transition(STATE_HALF_OPEN)
+                self._transition_locked(STATE_HALF_OPEN)
                 return True
             return False
 
@@ -85,7 +91,7 @@ class CircuitBreaker:
         with self._lock:
             self._failures = 0
             if self._state != STATE_CLOSED:
-                self._transition(STATE_CLOSED)
+                self._transition_locked(STATE_CLOSED)
 
     def record_failure(self) -> bool:
         """A device error occurred; returns True when the breaker is now
@@ -96,7 +102,7 @@ class CircuitBreaker:
                     self._state == STATE_CLOSED
                     and self._failures >= self.threshold):
                 self._opened_at = time.monotonic()
-                self._transition(STATE_OPEN)
+                self._transition_locked(STATE_OPEN)
             elif self._state == STATE_OPEN:
                 self._opened_at = time.monotonic()
             return self._state == STATE_OPEN

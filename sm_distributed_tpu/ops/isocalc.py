@@ -518,6 +518,12 @@ class PatternStream:
     clean shard prefix for the rerun to resume from.
     """
 
+    # smlint guarded-by registry (docs/ANALYSIS.md): the publication
+    # frontier + stream terminal state move only under _cond (row arrays
+    # themselves are single-writer, published via the _ready_rows barrier)
+    _GUARDED_BY = {"_ready_rows": "_cond", "_row_done": "_cond",
+                   "_error": "_cond", "_done": "_cond"}
+
     def __init__(self, wrapper: "IsocalcWrapper",
                  pairs: list[tuple[str, str]],
                  flags: list[bool] | None):
@@ -570,8 +576,8 @@ class PatternStream:
                 if hit is None:
                     missing.append((sf, ad))
                 else:
-                    self._fill_row(row_of[f"{sf}{ad}"], *hit)
-        self._advance_prefix()
+                    self._fill_row_locked(row_of[f"{sf}{ad}"], *hit)
+        self._advance_prefix_locked()
         chunk = _chunk_size(wrapper.chunk_size)
         self._chunks = [missing[s: s + chunk]
                         for s in range(0, len(missing), chunk)]
@@ -637,14 +643,17 @@ class PatternStream:
 
     # -- generation side -----------------------------------------------------
 
-    def _fill_row(self, row: int, mzs: np.ndarray, ints: np.ndarray) -> None:
+    def _fill_row_locked(self, row: int, mzs: np.ndarray,
+                         ints: np.ndarray) -> None:
+        # caller holds self._cond (or is __init__, pre-publication)
         k = min(mzs.size, self.mzs.shape[1])
         self.mzs[row, :k] = mzs[:k]
         self.ints[row, :k] = ints[:k]
         self.n_valid[row] = k
         self._row_done[row] = True
 
-    def _advance_prefix(self) -> None:
+    def _advance_prefix_locked(self) -> None:
+        # caller holds self._cond (or is __init__, pre-publication)
         r = self._ready_rows
         n = self.n_ions
         while r < n and self._row_done[r]:
@@ -708,8 +717,8 @@ class PatternStream:
         self.wrapper._commit_chunk_shard(self._job_tag, ci, entries)
         with self._cond:
             for ion, (mzs, ints) in entries.items():
-                self._fill_row(self._row_of[ion], mzs, ints)
-            self._advance_prefix()
+                self._fill_row_locked(self._row_of[ion], mzs, ints)
+            self._advance_prefix_locked()
             self._cond.notify_all()
         self.cold_patterns += len(entries)
         now = time.perf_counter()
@@ -850,6 +859,10 @@ class IsocalcWrapper:
     """
 
     _COMPACT_SHARDS = 64
+
+    # smlint guarded-by registry (docs/ANALYSIS.md): the in-memory pattern
+    # cache + dirty set are shared between streams and single-ion callers
+    _GUARDED_BY = {"_cache": "_lock", "_dirty": "_lock"}
 
     def __init__(
         self,
@@ -1018,8 +1031,13 @@ class IsocalcWrapper:
         for path in shards:
             try:
                 merged.update(self._load_shard(path))
-            except Exception:
-                continue  # shard a concurrent compactor already removed
+            except Exception as exc:
+                # a concurrent compactor already removed/replaced the shard
+                # (or it is corrupt — init's checksum pass unlinks those);
+                # either way its entries live on in base or recompute
+                logger.debug("isocalc compact: skipping shard %s (%s)",
+                             path.name, exc)
+                continue
         merged.update(self._cache)
         base = self.cache_dir / f"theor_peaks_{self._param_key()}.npz"
         tmp = self.cache_dir / f"tmp_{uuid.uuid4().hex[:8]}.npz"

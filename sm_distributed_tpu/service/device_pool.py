@@ -42,6 +42,8 @@ import sys
 import threading
 import time
 
+from ..utils.logger import logger
+
 
 class DeviceLease:
     """A (pending or granted) claim on ``n`` chips from a :class:`DevicePool`.
@@ -88,6 +90,13 @@ class DeviceLease:
 
 class DevicePool:
     """Allocate contiguous chip runs to leases, FIFO-ish, crash-safe."""
+
+    # shared-state registry checked by the smlint guarded-by rule
+    # (docs/ANALYSIS.md): mutated only under _cond (methods named *_locked
+    # are the documented caller-holds-lock exception)
+    _GUARDED_BY = {"_owner": "_cond", "_waiters": "_cond",
+                   "_compat": "_cond", "grants_total": "_cond",
+                   "releases_total": "_cond"}
 
     def __init__(self, size: int, max_bypass: int = 64):
         if size <= 0:
@@ -186,7 +195,8 @@ class DevicePool:
                 return False
         return True
 
-    def _grant(self, lease: DeviceLease, start: int) -> None:
+    def _grant_locked(self, lease: DeviceLease, start: int) -> None:
+        # caller holds self._cond
         for w in self._waiters:
             if w is lease:
                 break
@@ -226,7 +236,7 @@ class DevicePool:
                 if self._grant_allowed(lease):
                     start = self._find_run(lease.n)
                     if start is not None:
-                        self._grant(lease, start)
+                        self._grant_locked(lease, start)
                         return True
                 if not blocking:
                     return False     # stays queued — position is retained
@@ -307,11 +317,15 @@ def resolve_pool_size(cfg=None, backend: str | None = None) -> int:
     if mod is None and backend == "jax_tpu":
         try:
             import jax as mod  # noqa: F811 — the serve path needs it anyway
-        except Exception:
+        except Exception as exc:
+            logger.warning("device pool: jax unavailable (%s); "
+                           "falling back to a 1-chip pool", exc)
             return 1
     if mod is None:
         return 1
     try:
         return max(1, int(mod.local_device_count()))
-    except Exception:
+    except Exception as exc:
+        logger.warning("device pool: jax.local_device_count() failed (%s); "
+                       "falling back to a 1-chip pool", exc)
         return 1

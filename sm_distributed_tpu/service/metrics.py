@@ -48,6 +48,10 @@ class _Metric:
 
     kind = "untyped"
 
+    # smlint guarded-by registry (docs/ANALYSIS.md): the child map may only
+    # be mutated under the family lock (scrapes iterate it concurrently)
+    _GUARDED_BY = {"_children": "_lock"}
+
     def __init__(self, name: str, help: str = "", labelnames: tuple[str, ...] = ()):
         self.name = name
         self.help = help
@@ -93,6 +97,7 @@ class _Metric:
 
 class _CounterChild:
     __slots__ = ("value", "_lock")
+    _GUARDED_BY = {"value": "_lock"}
 
     def __init__(self):
         self.value = 0.0
@@ -123,6 +128,7 @@ class Counter(_Metric):
 
 class _GaugeChild:
     __slots__ = ("value", "_lock")
+    _GUARDED_BY = {"value": "_lock"}
 
     def __init__(self):
         self.value = 0.0
@@ -164,6 +170,9 @@ class Gauge(_Metric):
 
 class _HistogramChild:
     __slots__ = ("buckets", "counts", "sum", "count", "_lock")
+    # counts/sum/count move together; a torn view renders +Inf < a finite
+    # bucket (the ISSUE 6 scrape-vs-observe fix this registry pins)
+    _GUARDED_BY = {"counts": "_lock", "sum": "_lock", "count": "_lock"}
 
     def __init__(self, buckets: tuple[float, ...]):
         self.buckets = buckets
@@ -323,8 +332,8 @@ def process_collector(registry: "MetricsRegistry") -> None:
                 # usable leak signal on /proc-less platforms)
                 rss = float(resource.getrusage(
                     resource.RUSAGE_SELF).ru_maxrss) * 1024.0
-            except Exception:
-                pass
+            except (ImportError, OSError, ValueError):
+                pass                  # no RSS source at all: gauge omitted
         if rss:
             reg.gauge("sm_process_resident_memory_bytes",
                       "Resident set size of the service process").set(rss)
@@ -345,6 +354,9 @@ def process_collector(registry: "MetricsRegistry") -> None:
 
 class MetricsRegistry:
     """Registry: owns metric families + scrape-time collect callbacks."""
+
+    # smlint guarded-by registry (docs/ANALYSIS.md)
+    _GUARDED_BY = {"_metrics": "_lock", "_collectors": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()

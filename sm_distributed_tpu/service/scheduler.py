@@ -243,6 +243,18 @@ class _Attempt(threading.Thread):
 class JobScheduler:
     """Drain the spool with a worker pool under the service failure policy."""
 
+    # shared-state registry checked by the smlint guarded-by rule
+    # (docs/ANALYSIS.md): dispatcher, workers, watchdog, replica loop, and
+    # HTTP handlers all touch these maps — mutations only under
+    # _records_lock.  _owned is excluded deliberately: it is replaced
+    # wholesale by one writer (the replica loop) and read racily by design.
+    _GUARDED_BY = {"_records": "_records_lock", "_live": "_records_lock",
+                   "_trace_roots": "_records_lock",
+                   "_lease_by_msg": "_records_lock",
+                   "_inflight_by_tenant": "_records_lock",
+                   "_terminal_count": "_records_lock",
+                   "_fenced_count": "_records_lock"}
+
     def __init__(
         self,
         queue_dir: str | Path,

@@ -52,6 +52,11 @@ _OCCUPANCY_WINDOW = 60
 class DeviceMonitor:
     """Sample device/HBM/cache/occupancy state into gauges + a ring."""
 
+    # smlint guarded-by registry (docs/ANALYSIS.md): the snapshot ring is
+    # appended by the sampling thread and listed by HTTP handlers; _occ is
+    # deliberately sampling-thread-private (no lock declared)
+    _GUARDED_BY = {"_ring": "_lock"}
+
     def __init__(self, registry, cfg: TelemetryConfig | None = None,
                  device_token=None, queue_root: str | Path | None = None,
                  compile_cache_dir: str | Path | None = None,
@@ -295,6 +300,9 @@ class SLOTracker:
     measure).  Attainment comes from the histogram buckets themselves, so
     ``/slo`` and ``/metrics`` can never disagree.
     """
+
+    # smlint guarded-by registry (docs/ANALYSIS.md)
+    _GUARDED_BY = {"_submits": "_lock", "_first_noted": "_lock"}
 
     def __init__(self, registry, cfg: TelemetryConfig | None = None):
         self.cfg = cfg or TelemetryConfig()

@@ -44,6 +44,11 @@ class CancelToken:
     """Thread-safe one-shot cancellation flag with an optional absolute
     deadline and a progress heartbeat for the scheduler's stall watchdog."""
 
+    # smlint guarded-by registry (docs/ANALYSIS.md): the first-cancel-wins
+    # reason may only be written under _lock; last_progress/progress_phase
+    # are deliberately unsynchronized heartbeat fields (benign races)
+    _GUARDED_BY = {"reason": "_lock"}
+
     def __init__(self, deadline_at: float | None = None):
         self._event = threading.Event()
         self._lock = threading.Lock()

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import sys
 
+from .logger import logger
+
 # memory_stats key aliases across PJRT runtimes
 _IN_USE_KEYS = ("bytes_in_use", "bytes_used")
 _PEAK_KEYS = ("peak_bytes_in_use", "peak_bytes")
@@ -53,18 +55,24 @@ def device_stats(force_import: bool = False) -> list[dict]:
             return []
         try:
             import jax  # noqa: F811
-        except Exception:
+        except Exception as exc:
+            logger.debug("devicemem: jax import failed (%s); no device "
+                         "stats", exc)
             return []
     try:
         devices = jax.local_devices()
-    except Exception:
+    except Exception as exc:
+        logger.debug("devicemem: jax.local_devices() failed (%s); no "
+                     "device stats", exc)
         return []
     out = []
     for d in devices:
         stats = None
         try:
             stats = d.memory_stats()
-        except Exception:  # CPU backends raise or return None
+        except Exception as exc:  # CPU backends raise or return None
+            logger.debug("devicemem: memory_stats() unavailable on %r (%s)",
+                         d, exc)
             stats = None
         stats = stats if isinstance(stats, dict) else {}
         out.append({

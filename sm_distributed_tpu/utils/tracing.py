@@ -126,6 +126,9 @@ class TraceContext:
 class FlightRecorder:
     """Bounded ring of the most recent records, process-wide."""
 
+    # smlint guarded-by registry (docs/ANALYSIS.md)
+    _GUARDED_BY = {"_ring": "_lock"}
+
     def __init__(self, maxlen: int = 2048):
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=maxlen)

@@ -1,0 +1,223 @@
+"""Lock-order detector ("tsan-lite") tests — ISSUE 9.
+
+The headline satellite: a DETERMINISTIC deadlock fixture — two threads
+taking two locks in inverted order, sequenced so no real deadlock can
+occur — must be caught from the acquisition-order graph alone, which is
+the detector's entire value over timing-dependent testing.  The clean
+side (no false cycle on the real service stack) is proven by
+``scripts/load_sweep.py --smoke`` (in tier-1 via test_load_sweep) and
+``scripts/multichip_smoke.py`` (check_tier1), both of which now run
+instrumented and assert an acyclic graph; here we keep focused unit
+coverage of the wrapper semantics (RLock re-entry, Condition wait,
+scoping, restore).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from sm_distributed_tpu.analysis import lockorder
+
+SCOPE = ("tests/test_lockorder.py",)
+
+
+@pytest.fixture(autouse=True)
+def _restore():
+    # never leak the monkeypatch into other tests, even on failure
+    yield
+    lockorder.disable()
+
+
+def _run(fn) -> threading.Thread:
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    return t
+
+
+# --------------------------------------------------------------- deadlock
+def test_seeded_inverted_order_is_detected_without_deadlocking():
+    """The satellite fixture: thread 1 takes A then B, thread 2 takes B
+    then A — run strictly one-after-the-other (join between), so the
+    schedule is deterministic and cannot deadlock, yet the order graph
+    has the A->B->A cycle."""
+    lockorder.enable(scope=SCOPE)
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    assert type(lock_a).__name__ == "TrackedLock"
+
+    def t1():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def t2():
+        with lock_b:
+            with lock_a:
+                pass
+
+    _run(t1)
+    _run(t2)
+    rep = lockorder.report()
+    assert rep["edges"] == 2
+    assert rep["cycles"], "inverted lock order not detected"
+    with pytest.raises(lockorder.LockOrderError, match="cycle"):
+        lockorder.assert_no_cycles("fixture")
+
+
+def test_raise_mode_throws_in_the_acquiring_thread():
+    lockorder.enable(scope=SCOPE, mode="raise")
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def t1():
+        with lock_a:
+            with lock_b:
+                pass
+
+    errors: list[BaseException] = []
+
+    def t2():
+        try:
+            with lock_b:
+                with lock_a:   # closes the cycle -> raises BEFORE blocking
+                    pass
+        except lockorder.LockOrderError as exc:
+            errors.append(exc)
+
+    _run(t1)
+    _run(t2)
+    assert len(errors) == 1 and "cycle" in str(errors[0])
+
+
+def test_consistent_order_stays_clean():
+    lockorder.enable(scope=SCOPE)
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+
+    def t(n):
+        def body():
+            for _ in range(n):
+                with lock_a:
+                    with lock_b:
+                        pass
+        return body
+
+    threads = [threading.Thread(target=t(50)) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=10)
+    rep = lockorder.assert_no_cycles("consistent order")
+    assert rep["edges"] == 1 and not rep["cycles"]
+
+
+# ----------------------------------------------------------- lock semantics
+def test_rlock_reentry_records_no_self_edge():
+    lockorder.enable(scope=SCOPE)
+    r = threading.RLock()
+    other = threading.Lock()
+
+    with r:
+        with r:                       # re-entry: cannot block, no edge
+            with other:
+                pass
+    rep = lockorder.report()
+    assert rep["edges"] == 1          # only r -> other
+    assert not rep["cycles"]
+
+
+def test_same_site_nesting_is_tracked_but_not_a_cycle():
+    lockorder.enable(scope=SCOPE)
+
+    def make():
+        return threading.Lock()       # one site, two instances
+
+    l1, l2 = make(), make()
+    with l1:
+        with l2:
+            pass
+    rep = lockorder.report()
+    assert not rep["cycles"]
+    assert sum(rep["same_site_nesting"].values()) == 1
+
+
+def test_condition_wait_releases_and_reacquires_cleanly():
+    """Condition.wait must not leak a phantom hold: a waiter's held-set
+    drops the condition lock during wait, so locks the NOTIFIER takes
+    while the waiter sleeps cannot produce edges from the waiter."""
+    lockorder.enable(scope=SCOPE)
+    cond = threading.Condition()
+    ready = threading.Event()
+    done = threading.Event()
+    seen: list[bool] = []
+
+    def waiter():
+        with cond:
+            ready.set()
+            seen.append(cond.wait(timeout=5))
+        done.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    assert ready.wait(timeout=5)
+    with cond:
+        cond.notify_all()
+    assert done.wait(timeout=5)
+    t.join(timeout=5)
+    assert seen == [True]
+    rep = lockorder.assert_no_cycles("condition wait")
+    assert rep["locks_instrumented"] >= 1
+
+
+def test_condition_on_rlock_wait_then_lock_ordering_still_tracked():
+    lockorder.enable(scope=SCOPE)
+    cond = threading.Condition()
+    after = threading.Lock()
+
+    def body():
+        with cond:
+            cond.wait(timeout=0.01)   # times out; lock re-acquired
+            with after:               # edge cond -> after, exactly once
+                pass
+
+    _run(body)
+    rep = lockorder.report()
+    assert rep["edges"] == 1
+    assert not rep["cycles"]
+
+
+# ------------------------------------------------------------------ scoping
+def test_out_of_scope_locks_stay_raw():
+    lockorder.enable(scope=("no/such/path",))
+    lk = threading.Lock()
+    assert type(lk).__name__ != "TrackedLock"
+    with lk:
+        pass
+    assert lockorder.report()["locks_instrumented"] == 0
+
+
+def test_disable_restores_threading_and_wrappers_stay_usable():
+    lockorder.enable(scope=SCOPE)
+    lk = threading.Lock()
+    rep = lockorder.disable()
+    assert threading.Lock is lockorder._real_lock
+    assert rep["locks_instrumented"] == 1
+    with lk:                          # wrapper still functional, untracked
+        pass
+    assert not lockorder.enabled()
+
+
+def test_enable_from_env(monkeypatch):
+    monkeypatch.setenv("SM_LOCK_ORDER", "0")
+    assert lockorder.enable_from_env() is False
+    monkeypatch.setenv("SM_LOCK_ORDER", "raise")
+    assert lockorder.enable_from_env() is True
+    assert lockorder.report()["mode"] == "raise"
+    lockorder.disable()
+    monkeypatch.setenv("SM_LOCK_ORDER", "1")
+    assert lockorder.enable_from_env() is True
+    assert lockorder.report()["mode"] == "record"
