@@ -91,6 +91,16 @@ if ! env JAX_PLATFORMS=cpu python scripts/multichip_smoke.py; then
     exit 1
 fi
 
+# resource-exhaustion smoke gate (ISSUE 10): the spheroid fixture through
+# the real service under a 64 MB disk budget — trace-drop degrade visible
+# on /metrics with golden results, 507 shed at the submit floor, recovery
+# after free-up, retention GC keeps done/ under its cap, and the preflight
+# fast path stays microseconds-cheap
+if ! env JAX_PLATFORMS=cpu python scripts/resource_smoke.py; then
+    echo "check_tier1: FAIL — resource-exhaustion smoke gate failed" >&2
+    exit 1
+fi
+
 # replica failover smoke gate (ISSUE 8): 3 real scheduler replica
 # processes over one partitioned spool; killing one mid-score (and pausing
 # one into a fence race) must converge every job exactly-once to the

@@ -26,6 +26,10 @@ serving invariants after each mix:
   device errors (``backend.device_error`` failpoint), the circuit breaker
   demonstrably opens, jobs degrade to numpy scoring, and after the faults
   are healed a half-open probe closes it again;
+- **disk** (full matrix only, ISSUE 10): sustained traffic under a 64 MB
+  disk budget already past the trace floor — jobs complete with trace
+  writes dropped, deepening pressure sheds submits with a structured 507
+  + ``Retry-After``, and freeing the space recovers admissions in place;
 - **replicas** (full matrix only, ISSUE 8): a 10k-tenant-id traffic model
   over THREE real scheduler replica processes sharing one partitioned
   spool (``scripts/replica_chaos.py --replica-serve --bare`` — null jobs,
@@ -454,6 +458,73 @@ def mix_breaker(base: Path, fx: dict) -> None:
         breaker_mod.reset_device_breaker()
 
 
+def mix_disk(base: Path, fx: dict) -> None:
+    """Disk-pressure mix (ISSUE 10): sustained traffic under a 64 MB disk
+    budget already past the trace floor — every job completes with its
+    trace writes dropped, deepening the pressure to the submit floor sheds
+    with a structured 507 + Retry-After, and freeing the space recovers
+    admissions without a restart."""
+    mb = 1 << 20
+    h = Harness(base, "disk", sm_overrides={
+        "resources": {"disk_budget_bytes": 64 * mb,
+                      "trace_floor_bytes": 48 * mb,
+                      "cache_floor_bytes": 24 * mb,
+                      "submit_floor_bytes": 8 * mb,
+                      "gc_interval_s": 0.2},
+    })
+    filler = Path(h.sm_config.work_dir) / "filler.bin"
+    filler.parent.mkdir(parents=True, exist_ok=True)
+    try:
+        governor = h.service.resources
+        filler.write_bytes(b"\0" * (20 * mb))   # past the trace floor
+        deadline = time.time() + 10.0
+        while governor.level() < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        _check(governor.level() == 1, "disk: never reached trace-drop level")
+        accepted = []
+        for i in range(6):
+            status, _hd, body = h.submit(
+                _msg(fx, "fast", f"disk{i}", tenant=f"t{i % 2}"))
+            _check(status == 202, f"disk: level-1 submit shed ({status})")
+            accepted.append(body["msg_id"])
+        rows = h.wait_terminal(accepted)
+        bad = [m for m in accepted if rows[m]["state"] != "done"]
+        _check(not bad, f"disk: jobs under trace-drop not done: {bad}")
+        from sm_distributed_tpu.utils import tracing
+
+        for m in accepted:
+            tid = rows[m]["trace_id"]
+            _check(not tracing.trace_path(h.service.trace_dir, tid).exists(),
+                   f"disk: {m} wrote a trace file under pressure")
+        text = h.metrics_text()
+        _check('sm_disk_degraded_writes_total{kind="trace"}' in text,
+               "disk: trace-drop counter missing from /metrics")
+        # deepen to the submit floor: structured 507 shed
+        filler.write_bytes(b"\0" * (60 * mb))
+        deadline = time.time() + 10.0
+        while governor.level() < 3 and time.time() < deadline:
+            time.sleep(0.05)
+        status, headers, body = h.submit(_msg(fx, "fast", "disk_shed"))
+        _check(status == 507 and body.get("reason") == "disk_exhausted",
+               f"disk: expected structured 507, got {status} {body}")
+        _check("Retry-After" in headers, f"disk: no Retry-After: {headers}")
+        # free the space: admissions recover in place
+        filler.unlink()
+        deadline = time.time() + 10.0
+        while governor.level() > 0 and time.time() < deadline:
+            time.sleep(0.05)
+        status, _hd, body = h.submit(_msg(fx, "fast", "disk_recovered"))
+        _check(status == 202, f"disk: post-recovery submit shed ({status})")
+        h.wait_terminal([body["msg_id"]])
+        h.assert_clean("disk")
+        print(f"  disk: 6 jobs golden under trace-drop, 507 at the submit "
+              f"floor, recovery after free-up")
+    finally:
+        if filler.exists():
+            filler.unlink()
+        h.shutdown()
+
+
 def mix_replicas(base: Path, n_jobs: int = 600, tenant_space: int = 10_000,
                  n_replicas: int = 3, p99_bound_s: float = 30.0) -> None:
     """Multi-replica, 10k-tenant scheduling-plane mix with a mid-sweep
@@ -609,6 +680,7 @@ def run_sweep(work: Path, smoke: bool = False) -> int:
             h.shutdown()
         if not smoke:
             mix_breaker(work, fx)
+            mix_disk(work, fx)
             mix_replicas(work)
         rep = lockorder.assert_no_cycles("load sweep")
         print(f"lock-order: no cycles ({rep['locks_instrumented']} locks, "

@@ -12,6 +12,7 @@ Rules:
 - ``config-drift``      — SMConfig knobs <-> template <-> docs, both ways
 - ``guarded-by``        — declared shared attrs mutated only under their lock
 - ``broad-except``      — no silent ``except Exception`` swallows
+- ``atomic-write``      — spool/lease/registry writes use unique-tmp + os.replace
 """
 
 from __future__ import annotations
@@ -606,7 +607,133 @@ def guarded_by(project: Project):
                             f"without holding it")
 
 
-# ========================================================== 6. broad-except
+# ========================================================== 6. atomic-write
+# directories whose contents other processes/threads read CONCURRENTLY by
+# glob: a non-atomic write here is a torn-JSON/BadZipFile waiting for a
+# reader (the spool states, the fenced-lease files, the replica registry).
+# The convention (PR 1/2/8): write a unique tmp name, then os.replace /
+# Path.replace into place.
+_AW_DIRS = ("pending", "running", "done", "failed", "quarantine",
+            "leases", "replicas")
+_AW_WRITE_METHODS = ("write_text", "write_bytes")
+
+_AW_FIXTURE_FAIL = {
+    "sm_distributed_tpu/service/x.py": (
+        "class S:\n"
+        "    def bad_direct(self, msg_id, data):\n"
+        "        (self.root / 'failed' / msg_id).write_text(data)\n"
+        "    def bad_open(self, msg_id, data):\n"
+        "        dst = self.root / 'pending' / msg_id\n"
+        "        with open(dst, 'w') as f:\n"
+        "            f.write(data)\n"
+        "    def bad_tmp_no_replace(self, msg_id, data):\n"
+        "        tmp = self.root / 'pending' / f'.{msg_id}.tmp'\n"
+        "        tmp.write_text(data)\n"
+    ),
+}
+_AW_FIXTURE_PASS = {
+    "sm_distributed_tpu/service/x.py": (
+        "import os\n"
+        "class S:\n"
+        "    def good(self, msg_id, data):\n"
+        "        tmp = self.root / 'pending' / f'.{msg_id}.tmp'\n"
+        "        tmp.write_text(data)\n"
+        "        os.replace(tmp, self.root / 'pending' / f'{msg_id}.json')\n"
+        "    def good_path_replace(self, msg_id, data):\n"
+        "        tmp = self.root / 'leases' / f'.{msg_id}.tmp'\n"
+        "        tmp.write_text(data)\n"
+        "        tmp.replace(self.root / 'leases' / f'{msg_id}.json')\n"
+        "    def reader(self):\n"
+        "        return (self.root / 'done' / 'x.json').read_text()\n"
+    ),
+}
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    """``open(..., 'w'/'wb'/...)`` — any truncating/creating text/binary
+    write mode (append keeps prior bytes but still tears concurrent
+    readers; included)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = _const_str(call.args[1])
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = _const_str(kw.value)
+    return bool(mode) and any(c in mode for c in "wax")
+
+
+@rule("atomic-write", severity="error",
+      doc="Any open-for-write landing in a concurrently-globbed spool/"
+          "lease/registry directory (pending, running, done, failed, "
+          "quarantine, leases, replicas) must follow the unique-tmp + "
+          "os.replace convention: the write target must be a tmp name and "
+          "the same function must replace it into place afterwards.",
+      fixture_fail=_AW_FIXTURE_FAIL, fixture_pass=_AW_FIXTURE_PASS)
+def atomic_write(project: Project):
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue                  # scripts/benches are single-actor
+                                      # drivers over their own sandboxes
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            # locals assigned from expressions naming a protected dir;
+            # value = whether the SAME expression names a tmp component
+            tainted: dict[str, bool] = {}
+            replaces: list[int] = []
+            writes: list[tuple[ast.AST, str, bool]] = []
+            for node in ast.walk(fn):
+                if mod.enclosing_function(node) is not fn and node is not fn:
+                    continue          # skip nested defs/lambdas
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    strs = _subtree_strs(node.value)
+                    if strs & set(_AW_DIRS):
+                        tainted[node.targets[0].id] = any(
+                            "tmp" in s for s in strs)
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _call_name(node)
+                if callee == "replace":
+                    replaces.append(node.lineno)
+                    continue
+                target = None
+                if callee in _AW_WRITE_METHODS and \
+                        isinstance(node.func, ast.Attribute):
+                    target = node.func.value
+                elif callee == "open" and node.args and \
+                        _open_write_mode(node):
+                    target = node.args[0]
+                if target is None:
+                    continue
+                strs = _subtree_strs(target)
+                is_tmp = any("tmp" in s for s in strs)
+                hit = bool(strs & set(_AW_DIRS))
+                if not hit and isinstance(target, ast.Name) and \
+                        target.id in tainted:
+                    hit = True
+                    is_tmp = is_tmp or tainted[target.id]
+                if hit:
+                    writes.append((node, callee, is_tmp))
+            for node, callee, is_tmp in writes:
+                if not is_tmp:
+                    yield _finding(
+                        mod, node,
+                        f"{callee}() writes directly into a concurrently-"
+                        f"globbed spool/lease/registry directory — use a "
+                        f"unique tmp name + os.replace (torn writes become "
+                        f"reader-visible garbage)")
+                elif not any(ln > node.lineno for ln in replaces):
+                    yield _finding(
+                        mod, node,
+                        f"{callee}() writes a tmp file in a spool/lease/"
+                        f"registry directory but "
+                        f"{mod.qualname(node) or 'module scope'} never "
+                        f"os.replace()s it into place — half a convention "
+                        f"leaks orphan tmps")
+
+
+# ========================================================== 7. broad-except
 _LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
                 "log", "write"}
 

@@ -181,9 +181,15 @@ class QueuePublisher:
             raise ValueError("message needs at least ds_id and input_path")
         msg_id = msg.get("msg_id") or uuid.uuid4().hex
         msg = {**msg, "msg_id": msg_id, "published_at": time.time()}
+        payload = json.dumps(msg, indent=2)
+        # disk-budget preflight (ISSUE 10): a full disk refuses the publish
+        # BEFORE the tmp write — no orphan tmp, structured error upstream
+        from ..service import resources as _resources
+
+        _resources.preflight("spool.publish", len(payload) + 1024)
         tmp = self.root / "pending" / f".{msg_id}.tmp"
         dst = self.root / "pending" / f"{msg_id}.json"
-        tmp.write_text(json.dumps(msg, indent=2))
+        tmp.write_text(payload)
         failpoint(FP_PUBLISH_RENAME, path=tmp)
         os.replace(tmp, dst)          # atomic publish
         return dst

@@ -310,6 +310,14 @@ class SearchResultsStore:
         index never references annotations that are not on disk.
         """
         d = self.ds_dir(ds_id)
+        # disk-budget preflight (ISSUE 10, service/resources.py): deny the
+        # store up front — before any tmp write — when the headroom floor
+        # would be breached; rough estimate, refined by the GC rescan
+        from ..service import resources as _resources
+
+        _resources.preflight(
+            "storage.results_store",
+            256 * (len(bundle.annotations) + len(bundle.all_metrics)) + 8192)
         # sweep tmp debris a crashed previous store left behind: the rerun
         # overwrites the same names, but a FAILED-then-abandoned dataset
         # must not leak .tmp files forever

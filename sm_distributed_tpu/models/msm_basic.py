@@ -32,6 +32,7 @@ from ..utils.cancel import JobCancelledError
 from ..utils.config import DSConfig, SMConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger, phase_timer
+from . import oom
 from .breaker import get_device_breaker, record_degraded
 
 FP_SHARD_WRITE = register_failpoint(
@@ -45,7 +46,9 @@ FP_DEVICE_SCORE = register_failpoint(
 FP_DEVICE_ERROR = register_failpoint(
     "backend.device_error",
     "inside a device score_batches call — the consecutive-error seam the "
-    "circuit breaker counts (open -> degrade to numpy -> half-open probe)")
+    "circuit breaker counts (open -> degrade to numpy -> half-open probe); "
+    "raise:MemoryError injects an HBM RESOURCE_EXHAUSTED, which is a "
+    "SIZING signal: batch backoff, no breaker trip (models/oom.py)")
 
 
 # First-annotation observers (ISSUE 6): called once per search when the
@@ -361,6 +364,12 @@ class SearchCheckpoint:
              row_ranges: list[tuple[int, int]]) -> None:
         s, e = row_ranges[gi]
         rows = np.ascontiguousarray(metrics[s:e])
+        # disk-budget preflight (ISSUE 10, service/resources.py): a full
+        # disk fails the shard BEFORE a torn write, with headroom reserved
+        # for the seams below this one.  No-op outside the service.
+        from ..service import resources as _resources
+
+        _resources.preflight("ckpt.shard_write", rows.nbytes + 4096)
         tmp = self._shard(gi).with_suffix(".tmp.npz")  # same dir -> atomic
         np.savez(tmp, fingerprint=np.str_(self.fingerprint),
                  rows=rows, n_groups=n_groups,
@@ -431,6 +440,15 @@ class MSMBasicSearch:
         self.last_table: IsotopePatternTable | None = None
         self.last_backend = None
         self.last_checkpoint: SearchCheckpoint | None = None
+        # effective scoring batch (ISSUE 10): the config formula_batch,
+        # capped by a previously LEARNED proven-safe size for this
+        # (dataset shape, backend, lease) — set in _score_and_rank before
+        # the fingerprint (the checkpoint partition depends on it)
+        self._batch_eff = max(1, self.sm_config.parallel.formula_batch)
+        # in-flight OOM backoff cap: once a group halves its way to a
+        # fitting size, every LATER group of this search starts capped
+        # there (the device backend's padding batch already shrank)
+        self._oom_cap = 0
 
     def _fingerprint(self, table: IsotopePatternTable) -> str:
         """Identity of a search for checkpoint validity: the exact ion table
@@ -445,7 +463,10 @@ class MSMBasicSearch:
         h = hashlib.sha256()
         h.update(repr((self.ds.nrows, self.ds.ncols, int(self.ds.n_peaks),
                        img.ppm, img.nlevels, img.do_preprocessing, img.q,
-                       par.formula_batch, par.checkpoint_every)).encode())
+                       # the EFFECTIVE batch (== parallel.formula_batch
+                       # unless an OOM-learned safe size caps it): the
+                       # checkpoint partition is keyed on what actually ran
+                       self._batch_eff, par.checkpoint_every)).encode())
         stride = max(1, self.ds.mzs_flat.size // 65536)
         h.update(np.ascontiguousarray(self.ds.mzs_flat[::stride]).tobytes())
         h.update(np.ascontiguousarray(self.ds.ints_flat[::stride]).tobytes())
@@ -470,7 +491,10 @@ class MSMBasicSearch:
         h = hashlib.sha256()
         h.update(repr((self.ds.nrows, self.ds.ncols, int(self.ds.n_peaks),
                        img.ppm, img.nlevels, img.do_preprocessing, img.q,
-                       par.formula_batch, par.checkpoint_every)).encode())
+                       # the EFFECTIVE batch (== parallel.formula_batch
+                       # unless an OOM-learned safe size caps it): the
+                       # checkpoint partition is keyed on what actually ran
+                       self._batch_eff, par.checkpoint_every)).encode())
         stride = max(1, self.ds.mzs_flat.size // 65536)
         h.update(np.ascontiguousarray(self.ds.mzs_flat[::stride]).tobytes())
         h.update(np.ascontiguousarray(self.ds.ints_flat[::stride]).tobytes())
@@ -518,6 +542,46 @@ class MSMBasicSearch:
         return [(a, min(a + cap, e))
                 for s, e in group for a in range(s, e, cap)]
 
+    def _oom_key(self) -> str:
+        """Safe-batch registry key: what a batch's HBM footprint depends
+        on (models/oom.py)."""
+        return oom.shape_key(self.ds.n_pixels, self.sm_config.backend,
+                             self.device_indices)
+
+    @staticmethod
+    def _capped_slices(slices: list[tuple[int, int]],
+                       cap: int) -> list[tuple[int, int]]:
+        """Re-split scoring slices at ``cap`` ions.  The checkpoint
+        partition (group row ranges) is untouched — only the per-call
+        scoring grain shrinks, exactly like ``_reduced_slices``."""
+        return [(a, min(a + cap, e))
+                for s, e in slices for a in range(s, e, cap)]
+
+    def _oom_backoff(self, backend, slices: list[tuple[int, int]],
+                     cap: int, exc: BaseException) -> int:
+        """HBM OOM recovery (ISSUE 10): halve the scoring batch and tell
+        the device backend to shrink its static padding size.  Returns the
+        new cap, or 0 when the batch is already a single ion (nothing left
+        to shrink — the OOM is then a real failure for the retry policy,
+        but still NOT a breaker signal)."""
+        cur = cap or max(e - s for s, e in slices)
+        new = cur // 2
+        oom.record_oom_event("score_group", str(exc))
+        if new < 1:
+            logger.error(
+                "device OOM at a single-ion batch — cannot back off "
+                "further: %s", exc)
+            return 0
+        if hasattr(backend, "shrink_batch"):
+            backend.shrink_batch(new)
+        logger.warning(
+            "device OOM while scoring — a SIZING signal, not a device "
+            "fault (no breaker count): halving batch %d -> %d and "
+            "retrying in place (%s)", cur, new, exc)
+        tracing.event("oom_backoff", from_batch=cur, to_batch=new,
+                      error=str(exc)[:300])
+        return new
+
     def _score_group(self, backend, table, metrics: np.ndarray,
                      group: list[tuple[int, int]], breaker, use_device: bool,
                      degraded: bool):
@@ -527,51 +591,78 @@ class MSMBasicSearch:
         breaker OPENS and this group — and the rest of the job — degrades
         in place to the numpy oracle at reduced batch.  Metrics are
         backend-independent (bit-exact parity), so a mid-job switch is
-        invisible in the results.  Returns the (possibly swapped) backend
-        and degraded flag."""
+        invisible in the results.
+
+        HBM ``RESOURCE_EXHAUSTED`` is classified FIRST (models/oom.py):
+        it is a sizing signal, not a device fault — the batch halves and
+        the group rescores in place, the breaker never counts it, and the
+        converged size is remembered so the next job on this shape starts
+        there.  Returns the (possibly swapped) backend and degraded flag."""
         on_device = use_device and not degraded
         slices = self._reduced_slices(group) if degraded else group
-        try:
-            if on_device:
-                # injected consecutive-device-error seam (chaos sweep:
-                # breaker opens mid-job, degrades, converges to golden)
-                failpoint(FP_DEVICE_ERROR)
-            # lazy slices: every backend exposes score_batches; the jax
-            # one pipelines (async-enqueues all batches in the group
-            # before syncing any), the numpy one consumes one at a time
-            outs = backend.score_batches(
-                (_slice_table(table, s, e) for s, e in slices),
-                cancel=self.cancel)
-        except JobCancelledError:
-            raise
-        except Exception as exc:
-            injected = "backend.device_error" in str(exc)
-            if not (on_device or injected):
-                raise                 # a host-backend bug is not a device fault
-            now_open = breaker.record_failure()
-            logger.warning(
-                "device error while scoring (breaker %s after it): %s",
-                breaker.state, exc)
-            if not now_open:
-                raise                 # below threshold: let the retry policy
+        if self._oom_cap:
+            # an earlier group already backed off: the backend's padding
+            # batch is shrunk, so later groups must arrive pre-capped
+            slices = self._capped_slices(slices, self._oom_cap)
+        oom_cap = 0
+        while True:
+            try:
+                if on_device:
+                    # injected consecutive-device-error seam (chaos sweep:
+                    # breaker opens mid-job, degrades, converges to golden)
+                    failpoint(FP_DEVICE_ERROR)
+                # lazy slices: every backend exposes score_batches; the jax
+                # one pipelines (async-enqueues all batches in the group
+                # before syncing any), the numpy one consumes one at a time
+                outs = backend.score_batches(
+                    (_slice_table(table, s, e) for s, e in slices),
+                    cancel=self.cancel)
+            except JobCancelledError:
+                raise
+            except Exception as exc:
+                injected = "backend.device_error" in str(exc)
+                if not (on_device or injected):
+                    raise             # a host-backend bug is not a device fault
+                if oom.is_oom_error(exc):
+                    new_cap = self._oom_backoff(backend, slices, oom_cap, exc)
+                    if not new_cap:
+                        raise         # single-ion batch still OOMs: let the
+                                      # retry policy handle it — no breaker
+                    oom_cap = new_cap
+                    slices = self._capped_slices(slices, new_cap)
+                    continue
+                now_open = breaker.record_failure()
+                logger.warning(
+                    "device error while scoring (breaker %s after it): %s",
+                    breaker.state, exc)
+                if not now_open:
+                    raise             # below threshold: let the retry policy
                                       # probe the device again
-            record_degraded()
-            logger.warning(
-                "device breaker opened mid-job: degrading to the numpy "
-                "backend at batch %d",
-                self.sm_config.service.breaker_degraded_batch)
-            backend = NumpyBackend(self.ds, self.ds_config)
-            self.last_backend = backend
-            degraded = True
-            slices = self._reduced_slices(group)
-            outs = backend.score_batches(
-                (_slice_table(table, s, e) for s, e in slices),
-                cancel=self.cancel)
-        else:
-            if on_device:
-                # a cleanly scored device group closes a half-open probe
-                # and resets the consecutive-error count
-                breaker.record_success()
+                record_degraded()
+                logger.warning(
+                    "device breaker opened mid-job: degrading to the numpy "
+                    "backend at batch %d",
+                    self.sm_config.service.breaker_degraded_batch)
+                backend = NumpyBackend(self.ds, self.ds_config)
+                self.last_backend = backend
+                degraded = True
+                slices = self._reduced_slices(group)
+                outs = backend.score_batches(
+                    (_slice_table(table, s, e) for s, e in slices),
+                    cancel=self.cancel)
+                break
+            else:
+                if on_device:
+                    # a cleanly scored device group closes a half-open probe
+                    # and resets the consecutive-error count
+                    breaker.record_success()
+                break
+        if oom_cap:
+            # the group converged at oom_cap: proven-safe — later groups
+            # of THIS search stay capped, and later jobs on this
+            # (dataset shape, backend, lease) start there
+            self._oom_cap = oom_cap
+            oom.record_safe_batch(self._oom_key(), oom_cap)
         for (s, e), out in zip(slices, outs):
             metrics[s:e] = out
         return backend, degraded
@@ -636,6 +727,16 @@ class MSMBasicSearch:
             int((~table.targets).sum()), self.sm_config.backend,
             " (overlapping isocalc)" if overlap else "",
         )
+        # OOM memory (ISSUE 10): a previous job on this (dataset shape,
+        # backend, lease) proved a smaller batch fits in HBM — start there
+        # instead of rediscovering the RESOURCE_EXHAUSTED.  Must happen
+        # BEFORE the fingerprint: the checkpoint partition depends on it.
+        safe = oom.safe_batch_for(self._oom_key())
+        if safe and safe < self._batch_eff:
+            logger.info(
+                "oom: starting at learned safe batch %d (config %d) for %s",
+                safe, self._batch_eff, self._oom_key())
+            self._batch_eff = safe
         fingerprint = (self._fingerprint_pairs(table) if overlap
                        else self._fingerprint(table))
 
@@ -673,7 +774,12 @@ class MSMBasicSearch:
         else:
             backend = build()
         self.last_backend = backend
-        batch = max(1, self.sm_config.parallel.formula_batch)
+        batch = self._batch_eff
+        if batch < max(1, self.sm_config.parallel.formula_batch) and \
+                hasattr(backend, "shrink_batch"):
+            # the learned safe size also caps the device backend's static
+            # padding batch (padding to the config size would re-OOM)
+            backend.shrink_batch(batch)
         metrics = np.zeros((table.n_ions, 4))
         with phase_timer("score", timings):
             slices = [(s, min(s + batch, table.n_ions))

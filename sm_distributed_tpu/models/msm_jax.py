@@ -492,6 +492,18 @@ class JaxBackend:
             return self.batch
         return self._TAIL_BATCH if n <= self._TAIL_BATCH else self.batch
 
+    def shrink_batch(self, batch: int) -> None:
+        """HBM-OOM backoff hook (ISSUE 10, models/oom.py): cap the static
+        padding batch.  Smaller tables compile (cached) executables at the
+        new size; per-ion metrics are unchanged — batch size only sets
+        padding and scratch shape.  Shrink-only: growing mid-stream would
+        recompile for no benefit."""
+        new = max(1, int(batch))
+        if new < self.batch:
+            logger.warning("jax_tpu backend: formula batch %d -> %d "
+                           "(OOM backoff)", self.batch, new)
+            self.batch = new
+
     def _padded_windows(self, table: IsotopePatternTable, b: int | None = None):
         """Pad one batch's quantized windows to the static batch size
         (padded ions: bounds (0, 0), n_valid=0 -> all metrics 0) and rank

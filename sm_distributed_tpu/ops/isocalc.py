@@ -992,10 +992,22 @@ class IsocalcWrapper:
         "tmp_" PREFIX so the constructor's "theor_peaks_*" glob never sees a
         half-written file (np.savez force-appends .npz, so a suffix-based
         tmp would still match and a crashed/concurrent save would brick the
-        cache with BadZipFile)."""
+        cache with BadZipFile).
+
+        Disk pressure (ISSUE 10, service/resources.py): cache shards are
+        an OPTIONAL write — under degrade level >= 2 the shard is skipped
+        (patterns stay in this process's memory and simply recompute next
+        time), and the essential-write preflight still guards the hard
+        floor below that."""
         import os
         import uuid
 
+        from ..service import resources as _resources
+
+        if not _resources.allow_cache():
+            return
+        est = sum(m.nbytes + t.nbytes for m, t in entries.values()) + 8192
+        _resources.preflight("isocalc.shard_save", est)
         tmp = self.cache_dir / f"tmp_{uuid.uuid4().hex[:8]}.npz"
         np.savez(tmp, **self._stack_entries(entries))
         failpoint(FP_ISO_SHARD_SAVE, path=tmp)
@@ -1022,8 +1034,10 @@ class IsocalcWrapper:
         import os
         import uuid
 
-        if self.cache_dir is None:
-            return
+        from ..service import resources as _resources
+
+        if self.cache_dir is None or not _resources.allow_cache():
+            return                    # disk pressure: defer compaction too
         shards = self._shard_paths()
         if len(shards) <= self._COMPACT_SHARDS:
             return

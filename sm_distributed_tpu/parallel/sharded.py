@@ -258,6 +258,23 @@ class ShardedJaxBackend:
         )
         self._fns: dict[int, object] = {}      # gc_width -> jitted step
         self._gc_width = 0                     # sticky (see JaxBackend)
+        # the smallest legal batch: each formula shard's block must still
+        # split evenly across the pixel shards (see __init__ padding)
+        self._batch_granule = n_form_shards * n_pix_shards
+
+    def shrink_batch(self, batch: int) -> None:
+        """HBM-OOM backoff hook (ISSUE 10, models/oom.py) — same contract
+        as ``JaxBackend.shrink_batch`` but clamped to the mesh's batch
+        granule (formula shards × pixel shards): below that, padding
+        cannot shrink and memory relief must come from the mesh geometry
+        instead (more pixel shards)."""
+        new = max(self._batch_granule,
+                  _round_up(max(1, int(batch)), self._batch_granule))
+        if new < self.batch:
+            logger.warning("sharded jax_tpu backend: formula batch %d -> %d "
+                           "(OOM backoff, granule %d)", self.batch, new,
+                           self._batch_granule)
+            self.batch = new
 
     def _restrict_shards(self, mz_s, px_s, in_s, table):
         """Drop peaks outside the union of ``table``'s windows from every

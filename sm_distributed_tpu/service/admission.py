@@ -34,6 +34,7 @@ from pathlib import Path
 
 from ..utils.config import AdmissionConfig
 from ..utils.logger import logger
+from .resources import get_governor
 
 
 @dataclass
@@ -136,6 +137,19 @@ class AdmissionController:
         state.  Peer numbers are one heartbeat old at worst; the
         approximation errs by at most one beat's worth of admissions."""
         cfg = self.cfg
+        # disk exhaustion (ISSUE 10, service/resources.py): the LAST step
+        # of the degrade order — traces and cache writes are already being
+        # dropped by the time submits shed.  507 Insufficient Storage with
+        # Retry-After: accepting a job we cannot durably store its results
+        # for would only convert the client's retry into a dead-letter.
+        governor = get_governor()
+        if governor is not None and governor.submits_shed():
+            d = Decision(False, 507, "disk_exhausted", cfg.retry_after_s,
+                         "disk budget exhausted: new submits shed until "
+                         "the retention sweeper (or an operator) frees "
+                         "space")
+            self._count("shed", d.reason)
+            return d
         peers = self._peer_summaries()
         peer_depth = sum(int(p.get("depth", 0)) for p in peers)
         peer_tenant = sum(int((p.get("tenants") or {}).get(tenant, 0))

@@ -34,7 +34,13 @@ RabbitMQ's management UI):
   live histograms (``service/telemetry.py``);
 - ``GET /debug/timeseries?n=``  the telemetry monitor's bounded ring of
   periodic metric snapshots (per-device HBM, device-token occupancy,
-  queue depths, XLA cache size, RSS).
+  queue depths, XLA cache size, RSS);
+- ``GET /debug/resources``  the resource governor's snapshot
+  (``service/resources.py``): disk degrade level + headroom, per-seam
+  preflight denials, retention-GC stats, and the HBM-OOM safe-batch
+  registry.  Submits shed by a disk-budget breach return **507** with a
+  ``Retry-After`` header (the last step of the traces → cache → submits
+  degrade order).
 
 ``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
 handler is read-only except ``/submit`` (appends to ``pending/``) and
@@ -152,6 +158,9 @@ class AdminAPI:
                         n = int(q.get("n", ["256"])[0] or 256)
                         self._reply_json(
                             200, tracing.flight_recorder.recent(n))
+                    elif url.path == "/debug/resources":
+                        status, body = api._resources()
+                        self._reply_json(status, body)
                     elif url.path == "/debug/timeseries":
                         q = parse_qs(url.query)
                         n = q.get("n", [None])[0]
@@ -354,6 +363,16 @@ class AdminAPI:
             "n": len(samples),
             "samples": samples,
         }
+
+    def _resources(self) -> tuple[int, dict]:
+        """``GET /debug/resources`` — the resource governor's snapshot
+        (ISSUE 10): degrade level, headroom, per-seam denials, GC stats,
+        and the OOM safe-batch registry (service/resources.py)."""
+        governor = getattr(self.service, "resources", None)
+        if governor is None:
+            return 404, {"error": "resource governor not configured",
+                         "reason": "not_found"}
+        return 200, governor.snapshot()
 
     def _peers(self) -> dict:
         """``GET /peers`` — the replica registry view (ISSUE 8): this

@@ -266,6 +266,7 @@ class JobScheduler:
         trace_dir: str | Path | None = None,
         slo=None,
         device_pool: DevicePool | None = None,
+        resources=None,
     ):
         self.root = Path(queue_dir) / queue
         for s in _STATES:
@@ -287,6 +288,12 @@ class JobScheduler:
         # SLO tracker (service/telemetry.py): queue-wait observed at each
         # job's first attempt start, e2e latency at every terminal outcome
         self.slo = slo
+        # resource governor (ISSUE 10, service/resources.py): the replica
+        # loop runs its bounded-retention GC sweep on gc_interval_s,
+        # scoped to this replica's shards via owns_msg — N replicas sweep
+        # one spool without double-reaping, and takeover shifts sweep
+        # ownership with shard ownership.  None = no GC, no budget.
+        self.resources = resources
         # the device POOL (ISSUE 7): jobs lease 1..N chips; small jobs pack
         # onto distinct chips, sub-mesh jobs claim contiguous runs.  The
         # pool still speaks the old single-token Lock protocol, and
@@ -1260,8 +1267,12 @@ class JobScheduler:
         own cadences.  A beat/scan fault never kills the loop."""
         next_beat = 0.0
         next_scan = 0.0
+        next_gc = 0.0
+        gc_interval = (self.resources.cfg.gc_interval_s
+                       if self.resources is not None else float("inf"))
         tick = max(0.02, min(self.cfg.replica_heartbeat_interval_s,
-                             self.cfg.takeover_interval_s) / 4.0)
+                             self.cfg.takeover_interval_s,
+                             gc_interval) / 4.0)
         while not self._stop.is_set():
             now = time.time()
             if now >= next_beat:
@@ -1281,6 +1292,15 @@ class JobScheduler:
                     logger.warning("replica %s: takeover scan failed",
                                    self.replica_id, exc_info=True)
                 next_scan = now + self.cfg.takeover_interval_s
+            if self.resources is not None and now >= next_gc:
+                # bounded-retention GC (ISSUE 10): shard-scoped like the
+                # takeover sweeps above — a GC fault never kills the loop
+                try:
+                    self.resources.gc_tick(owns_msg=self.owns_msg)
+                except OSError:
+                    logger.warning("replica %s: resource GC tick failed",
+                                   self.replica_id, exc_info=True)
+                next_gc = now + gc_interval
             self._stop.wait(tick)
 
     # ------------------------------------------------------------ lifecycle

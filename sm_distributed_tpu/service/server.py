@@ -17,6 +17,7 @@ import time
 from pathlib import Path
 
 from ..engine.daemon import QUEUE_ANNOTATE, QueuePublisher, _STATES
+from ..models import oom
 from ..models.breaker import attach_metrics as attach_breaker_metrics
 from ..models.breaker import get_device_breaker
 from ..utils import tracing
@@ -27,6 +28,7 @@ from .admission import AdmissionController
 from .api import AdminAPI
 from .device_pool import DevicePool, resolve_pool_size
 from .metrics import MetricsRegistry, build_info_collector, process_collector
+from .resources import ResourceGovernor, set_governor
 from .scheduler import JobScheduler
 from .telemetry import DeviceMonitor, SLOTracker
 
@@ -75,10 +77,31 @@ class AnnotationService:
             resolve_pool_size(cfg, backend=self.sm_config.backend),
             max_bypass=cfg.device_pool_max_bypass)
         self.device_pool.attach_metrics(self.metrics)
+        # resource governor (ISSUE 10, service/resources.py): disk-budget
+        # preflight at every governed write seam, degrade order traces →
+        # cache → 507 submits, bounded-retention GC run from the
+        # scheduler's replica loop.  Installed as the process singleton so
+        # the engine seams (checkpoints, results, publish, cache shards)
+        # and the admission controller consult it without plumbing;
+        # tracing's file gate makes trace appends the FIRST thing dropped.
+        self.resources = ResourceGovernor(
+            self.sm_config.resources,
+            work_dir=self.sm_config.work_dir,
+            results_dir=self.sm_config.storage.results_dir,
+            queue_root=self.queue_dir / queue,
+            trace_dir=self.trace_dir,
+            cache_dir=Path(self.sm_config.work_dir) / "isocalc_cache",
+            tracing_cfg=self.sm_config.tracing,
+            metrics=self.metrics, replica_id=cfg.replica_id)
+        set_governor(self.resources)
+        tracing.set_file_gate(self.resources.trace_gate)
+        # HBM-OOM adaptive-scoring telemetry (models/oom.py): events,
+        # converged backoffs, and the learned safe batch on /metrics
+        oom.attach_metrics(self.metrics)
         self.scheduler = JobScheduler(
             queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
             admission=self.admission, trace_dir=self.trace_dir, slo=self.slo,
-            device_pool=self.device_pool)
+            device_pool=self.device_pool, resources=self.resources)
         # replica-scoped spool re-adoption + the registry-backed peer view:
         # each replica tracks its own shards and folds the peers' gossiped
         # summaries into its quota/shed decisions (GET /peers serves both)
@@ -188,6 +211,13 @@ class AnnotationService:
 
         remove_first_annotation_observer(self.slo.note_first_annotation)
         remove_phase_observer(self._observe_phase)
+        # detach the resource governor so a later service (tests run many
+        # per process) starts from its own budget, not this one's
+        from .resources import get_governor
+
+        if get_governor() is self.resources:
+            tracing.set_file_gate(None)
+            set_governor(None)
         return ok
 
     def install_signal_handlers(self) -> None:

@@ -339,10 +339,73 @@ class TracingConfig:
     enabled: bool = True                 # span/event emission on traced jobs
     dir: str = ""                        # trace-file dir; "" = <work_dir>/traces
     ring_size: int = 2048                # flight-recorder record capacity
+    # bounded retention for the ON-DISK per-job trace files (the flight-
+    # recorder ring is already bounded; the files were not — ISSUE 10
+    # satellite).  Enforced by the resource governor's GC sweeper
+    # (service/resources.py): files older than retention_age_s are removed,
+    # and when the trace dir exceeds retention_max_bytes the oldest files
+    # go first.  0 disables that dimension.
+    retention_age_s: float = 0.0
+    retention_max_bytes: int = 0
 
     def __post_init__(self):
         if self.ring_size <= 0:
             raise ValueError("tracing.ring_size must be positive")
+        if self.retention_age_s < 0 or self.retention_max_bytes < 0:
+            raise ValueError("tracing.retention_* must be >= 0")
+
+
+@dataclass(frozen=True)
+class ResourcesConfig:
+    """Resource-exhaustion survival (service/resources.py, docs/RECOVERY.md
+    "Resource exhaustion"): disk-budget governor + bounded-retention GC.
+    The governor preflights every governed write seam and degrades in a
+    configured order as headroom shrinks — trace writes drop first
+    (remaining < trace_floor_bytes), then isocalc cache writes
+    (< cache_floor_bytes), then new submits shed with a structured 507
+    (< submit_floor_bytes); essential writes (checkpoints, results, spool)
+    are denied only when the floor itself would be breached."""
+    min_free_bytes: int = 0              # filesystem free-space reserve the
+                                         # governor protects (0 disables the
+                                         # statvfs constraint)
+    disk_budget_bytes: int = 0           # cap on bytes under the governed
+                                         # roots (work/results/queue);
+                                         # 0 = free-space constraint only
+    trace_floor_bytes: int = 32 << 20    # remaining headroom below which
+                                         # trace-file writes are dropped
+    cache_floor_bytes: int = 16 << 20    # ... below which isocalc cache
+                                         # shard writes are dropped
+    submit_floor_bytes: int = 8 << 20    # ... below which POST /submit
+                                         # sheds with 507 + Retry-After
+    gc_interval_s: float = 30.0          # retention sweep + usage rescan
+                                         # cadence (scheduler replica loop)
+    done_retention_age_s: float = 0.0    # spool done/ messages older than
+                                         # this are removed (0 = keep)
+    failed_retention_age_s: float = 0.0  # dead-letter/quarantine evidence
+                                         # older than this is removed
+                                         # (0 = keep)
+    cache_retention_max_bytes: int = 0   # isocalc cache size cap — oldest
+                                         # shards removed first (0 = keep)
+    registry_retention_age_s: float = 3600.0  # crashed replicas' registry
+                                         # heartbeat files older than this
+                                         # are removed (they never retire)
+
+    def __post_init__(self):
+        if min(self.min_free_bytes, self.disk_budget_bytes,
+               self.cache_retention_max_bytes) < 0:
+            raise ValueError("resources: byte knobs must be >= 0")
+        if not (self.trace_floor_bytes >= self.cache_floor_bytes
+                >= self.submit_floor_bytes >= 0):
+            raise ValueError(
+                "resources: degrade floors must be ordered "
+                "trace_floor_bytes >= cache_floor_bytes >= "
+                "submit_floor_bytes >= 0 (traces drop first, then cache, "
+                "then submits)")
+        if self.gc_interval_s <= 0:
+            raise ValueError("resources.gc_interval_s must be positive")
+        if min(self.done_retention_age_s, self.failed_retention_age_s,
+               self.registry_retention_age_s) < 0:
+            raise ValueError("resources: retention ages must be >= 0")
 
 
 @dataclass(frozen=True)
@@ -373,6 +436,7 @@ class SMConfig:
     service: ServiceConfig = field(default_factory=ServiceConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    resources: ResourcesConfig = field(default_factory=ResourcesConfig)
     logs: LogsConfig = field(default_factory=LogsConfig)
     work_dir: str = "/tmp/sm_tpu_work"
     logs_dir: str = ""                   # "" = console only
@@ -434,6 +498,7 @@ _DATACLASS_FIELDS = {
     ("SMConfig", "service"): ServiceConfig,
     ("SMConfig", "tracing"): TracingConfig,
     ("SMConfig", "telemetry"): TelemetryConfig,
+    ("SMConfig", "resources"): ResourcesConfig,
     ("SMConfig", "logs"): LogsConfig,
     ("ServiceConfig", "admission"): AdmissionConfig,
 }

@@ -31,6 +31,11 @@ Actions:
     torn[:fraction]  truncate the file handed to ``failpoint(..., path=)`` to
                      ``fraction`` of its bytes (default 0.5) and CONTINUE —
                      simulating a torn write that later commits garbage
+    enospc           raise ``OSError(errno.ENOSPC, "No space left on
+                     device")`` — a full disk at exactly this write seam
+                     (ISSUE 10: every seam the disk-budget governor guards
+                     is chaos-testable with the same fault the kernel
+                     would deliver)
 
 Triggers (both deterministic):
     @N       fire on the Nth hit of this failpoint only (1-based, per process)
@@ -135,8 +140,10 @@ def _parse_one(name: str, rhs: str) -> _Spec:
     arg = m.group("arg")
     nth = int(m.group("nth")) if m.group("nth") else None
     prob = float(m.group("prob")) if m.group("prob") else None
-    if action not in ("raise", "crash", "sleep", "torn"):
+    if action not in ("raise", "crash", "sleep", "torn", "enospc"):
         raise ValueError(f"failpoint {name}: unknown action {action!r}")
+    if action == "enospc" and arg:
+        raise ValueError(f"failpoint {name}: enospc takes no argument")
     if action == "raise" and arg and arg not in _EXCEPTIONS:
         raise ValueError(
             f"failpoint {name}: exception {arg!r} not in "
@@ -298,6 +305,13 @@ def failpoint(name: str, path: str | os.PathLike | None = None) -> None:
     if spec.action == "raise":
         exc = _EXCEPTIONS[spec.arg or "FailpointError"]
         raise exc(f"injected failpoint {name} (hit {spec.hits})")
+    if spec.action == "enospc":
+        import errno
+
+        raise OSError(
+            errno.ENOSPC,
+            f"No space left on device [injected failpoint {name} "
+            f"(hit {spec.hits})]", str(path) if path is not None else None)
     if spec.action == "crash":
         os._exit(int(spec.arg or 21))
     if spec.action == "sleep":

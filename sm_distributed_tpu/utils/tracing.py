@@ -74,7 +74,14 @@ from collections import deque
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from .failpoints import failpoint, register_failpoint
+
 _log = logging.getLogger("sm-tpu")
+
+FP_TRACE_APPEND = register_failpoint(
+    "trace.append",
+    "inside a per-job trace file append (I/O error / ENOSPC) — trace "
+    "emission must never fail the pipeline")
 
 RECORD_KINDS = ("span", "event")
 # required keys per record kind (validate_records + the smoke gate)
@@ -191,6 +198,20 @@ def replica() -> str:
 _files_lock = threading.Lock()
 _files: dict[str, object] = {}
 
+# disk-pressure gate (ISSUE 10): the resource governor installs a callable
+# consulted before every FILE write — under disk pressure trace writes are
+# the FIRST thing dropped (ring records keep flowing).  None = always write.
+# The gate must be cheap and non-raising; it is called outside _files_lock.
+_file_gate = None
+
+
+def set_file_gate(fn) -> None:
+    """Install (or clear, with ``None``) the trace-file write gate.  The
+    service wires this to ``ResourceGovernor.trace_gate`` so a disk-budget
+    breach drops trace APPENDS before anything essential degrades."""
+    global _file_gate
+    _file_gate = fn
+
 
 def _file_handle_locked(path: str):
     """Caller holds ``_files_lock``."""
@@ -215,15 +236,41 @@ def close_files() -> None:
         _files.clear()
 
 
+def close_file(path: str | Path) -> None:
+    """Drop one cached append handle (the GC sweeper calls this before
+    unlinking an aged trace file, so a later append to the same trace id
+    reopens instead of writing to an unlinked inode)."""
+    key = str(path)
+    with _files_lock:
+        f = _files.pop(key, None)
+        if f is not None:
+            with contextlib.suppress(OSError):
+                f.close()
+
+
+# reentrancy guard for the trace.append failpoint: a FIRED failpoint emits
+# its own "failpoint" trace event, which re-enters _emit — without the
+# guard an every-hit spec would recurse without bound
+_appending = threading.local()
+
+
 def _emit(rec: dict, file: str) -> None:
     buf = _CAPTURE.get()
     if buf is not None:               # worker-side capture: no sinks here
         buf.append(rec)
         return
     flight_recorder.record(rec)
-    if file:
+    if file and (_file_gate is None or _file_gate()):
         line = json.dumps(rec, default=str) + "\n"
         try:
+            # the governed-write seam (ISSUE 10): ENOSPC/I/O faults here
+            # must degrade to a lost trace line, never a failed job
+            if not getattr(_appending, "active", False):
+                _appending.active = True
+                try:
+                    failpoint(FP_TRACE_APPEND, path=file)
+                finally:
+                    _appending.active = False
             with _files_lock:         # whole-line writes, never interleaved
                 f = _file_handle_locked(file)
                 f.write(line)

@@ -60,3 +60,15 @@ def _reset_device_breaker():
     breaker.reset_device_breaker()
     yield
     breaker.reset_device_breaker()
+
+
+@pytest.fixture(autouse=True)
+def _reset_oom_registry():
+    """Isolate the OOM safe-batch memory (models/oom.py): a learned batch
+    from one test must not silently shrink every later search on the same
+    fixture shape."""
+    from sm_distributed_tpu.models import oom
+
+    oom.reset()
+    yield
+    oom.reset()

@@ -1,0 +1,341 @@
+"""Resource-exhaustion survival (ISSUE 10): the enospc failpoint action
+over every governed write seam, the disk-budget governor's degrade order,
+the bounded-retention GC, and the 507 admission shed."""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from sm_distributed_tpu.engine.daemon import QueueConsumer, QueuePublisher
+from sm_distributed_tpu.service import resources as res_mod
+from sm_distributed_tpu.service.resources import (
+    ResourceBudgetError,
+    ResourceGovernor,
+)
+from sm_distributed_tpu.utils import failpoints, tracing
+from sm_distributed_tpu.utils.config import (
+    AdmissionConfig,
+    ResourcesConfig,
+    TracingConfig,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    failpoints.reset()
+    res_mod.set_governor(None)
+    tracing.set_file_gate(None)
+    yield
+    failpoints.reset()
+    res_mod.set_governor(None)
+    tracing.set_file_gate(None)
+
+
+# -------------------------------------------------- the enospc action itself
+def test_enospc_action_parses_and_rejects_args():
+    spec = failpoints.parse_failpoints("x.y=enospc@2")
+    assert spec["x.y"].action == "enospc" and spec["x.y"].nth == 2
+    with pytest.raises(ValueError, match="takes no argument"):
+        failpoints.parse_failpoints("x.y=enospc:9")
+
+
+def test_enospc_raises_oserror_with_enospc_errno(tmp_path):
+    failpoints.configure("spool.publish_rename=enospc@1")
+    pub = QueuePublisher(tmp_path)
+    with pytest.raises(OSError) as ei:
+        pub.publish({"ds_id": "d", "input_path": "x", "msg_id": "m1"})
+    assert ei.value.errno == errno.ENOSPC
+    assert "No space left on device" in str(ei.value)
+
+
+# ------------------------------------------------ ENOSPC at every governed seam
+def test_enospc_at_publish_recovers_clean(tmp_path):
+    """Publish fails mid-flight; the orphan tmp is swept and the client's
+    republish lands — zero debris."""
+    failpoints.configure("spool.publish_rename=enospc@1")
+    pub = QueuePublisher(tmp_path)
+    with pytest.raises(OSError):
+        pub.publish({"ds_id": "d", "input_path": "x", "msg_id": "m1"})
+    root = pub.root
+    assert list((root / "pending").glob(".*.tmp"))  # the torn-publish debris
+    assert QueueConsumer(tmp_path, callback=None).sweep_orphans(
+        max_age_s=0.0) == 1
+    dst = pub.publish({"ds_id": "d", "input_path": "x", "msg_id": "m1"})
+    assert dst.exists()
+    assert not list((root / "pending").glob(".*.tmp"))
+
+
+def test_enospc_at_checkpoint_shard_then_rerun(tmp_path):
+    from sm_distributed_tpu.models.msm_basic import SearchCheckpoint
+
+    ckpt = SearchCheckpoint(tmp_path, "fp")
+    metrics = np.arange(8.0).reshape(2, 4)
+    ranges = [(0, 2)]
+    failpoints.configure("ckpt.shard_write=enospc@1")
+    with pytest.raises(OSError) as ei:
+        ckpt.save(metrics, 0, 1, ranges)
+    assert ei.value.errno == errno.ENOSPC
+    # the retry (failpoint spent) overwrites the same tmp name and commits
+    ckpt.save(metrics, 0, 1, ranges)
+    restored = np.zeros_like(metrics)
+    assert ckpt.load(restored, 1, ranges) == 1
+    np.testing.assert_array_equal(restored, metrics)
+    ckpt.finalize()
+    assert not list(tmp_path.glob("*.npz"))
+
+
+def test_enospc_at_results_store_then_rerun(tmp_path):
+    from sm_distributed_tpu.engine.storage import JobLedger, SearchResultsStore
+    from sm_distributed_tpu.models.msm_basic import SearchResultsBundle
+
+    ledger = JobLedger(tmp_path / "results")
+    store = SearchResultsStore(ledger, store_images=False)
+    ann = pd.DataFrame({"sf": ["H2O"], "adduct": ["+H"], "msm": [0.5],
+                        "fdr": [0.1], "fdr_level": [0.1], "chaos": [0.9],
+                        "spatial": [0.8], "spectral": [0.7]})
+    allm = ann.assign(is_target=True)[
+        ["sf", "adduct", "is_target", "chaos", "spatial", "spectral", "msm"]]
+    bundle = SearchResultsBundle(annotations=ann, all_metrics=allm)
+    job = ledger.start_job("ds1")
+    failpoints.configure("storage.results_rename=enospc@1")
+    with pytest.raises(OSError):
+        store.store("ds1", job, bundle)
+    # rerun sweeps the stale tmps and commits
+    d = store.store("ds1", job, bundle)
+    assert (d / "annotations.parquet").exists()
+    assert not list(d.glob("*.tmp"))
+    ledger.close()
+
+
+def test_enospc_at_isocalc_shard_then_rerun(tmp_path):
+    from sm_distributed_tpu.ops.isocalc import IsocalcWrapper
+    from sm_distributed_tpu.utils.config import IsotopeGenerationConfig
+
+    w = IsocalcWrapper(IsotopeGenerationConfig(), cache_dir=str(tmp_path))
+    entries = {"H2O|+H": (np.array([18.01]), np.array([1.0]))}
+    shard = tmp_path / "theor_peaks_test_c00000.npz"
+    failpoints.configure("isocalc.shard_save=enospc@1")
+    with pytest.raises(OSError):
+        w._write_shard(shard, entries)
+    assert not shard.exists()
+    w._write_shard(shard, entries)      # failpoint spent: commits
+    assert shard.exists()
+    loaded = w._load_shard(shard)
+    np.testing.assert_allclose(loaded["H2O|+H"][0], [18.01])
+
+
+def test_enospc_at_trace_append_never_fails_the_pipeline(tmp_path):
+    failpoints.configure("trace.append=enospc@1")
+    ctx = tracing.new_trace(job_id="j1", trace_dir=tmp_path)
+    with tracing.attach(ctx):
+        with tracing.span("unlucky"):    # first append: injected ENOSPC
+            pass
+        with tracing.span("lucky"):      # second append lands
+            pass
+    tracing.close_files()
+    records = tracing.read_trace(tracing.trace_path(tmp_path, ctx.trace_id))
+    names = [r["name"] for r in records]
+    assert "lucky" in names and "unlucky" not in names
+    # the dropped span still reached the flight recorder
+    assert any(r.get("name") == "unlucky"
+               for r in tracing.flight_recorder.recent(64))
+
+
+# ------------------------------------------------------- governor: degrade order
+def _governor(tmp_path, **cfg_over) -> ResourceGovernor:
+    cfg = ResourcesConfig(**{
+        "disk_budget_bytes": 1_000_000, "trace_floor_bytes": 600_000,
+        "cache_floor_bytes": 400_000, "submit_floor_bytes": 200_000,
+        **cfg_over})
+    work = tmp_path / "work"
+    work.mkdir(exist_ok=True)
+    return ResourceGovernor(cfg, work_dir=work,
+                            trace_dir=tmp_path / "work" / "traces",
+                            queue_root=tmp_path / "queue")
+
+
+def _fill(tmp_path, total_bytes: int) -> None:
+    (tmp_path / "work" / "filler.bin").write_bytes(b"\0" * total_bytes)
+
+
+def test_degrade_order_traces_then_cache_then_submits(tmp_path):
+    g = _governor(tmp_path)
+    assert g.level() == res_mod.LEVEL_OK
+    assert g.trace_gate() and g.allow_cache() and not g.submits_shed()
+
+    _fill(tmp_path, 500_000)            # remaining 500k < 600k trace floor
+    g.rescan_usage()
+    assert g.level() == res_mod.LEVEL_NO_TRACES
+    assert not g.trace_gate() and g.allow_cache() and not g.submits_shed()
+
+    _fill(tmp_path, 700_000)            # remaining 300k < 400k cache floor
+    g.rescan_usage()
+    assert g.level() == res_mod.LEVEL_NO_CACHE
+    assert not g.trace_gate() and not g.allow_cache()
+    assert not g.submits_shed()
+
+    _fill(tmp_path, 900_000)            # remaining 100k < 200k submit floor
+    g.rescan_usage()
+    assert g.level() == res_mod.LEVEL_SHED_SUBMITS
+    assert g.submits_shed()
+
+    (tmp_path / "work" / "filler.bin").unlink()
+    g.rescan_usage()
+    assert g.level() == res_mod.LEVEL_OK
+    snap = g.snapshot()
+    assert snap["degraded_writes"]["trace"] >= 2
+    assert snap["degraded_writes"]["cache"] >= 1
+
+
+def test_preflight_denies_at_the_floor_and_tracks_pending(tmp_path):
+    g = _governor(tmp_path)
+    g.preflight("seamA", 300_000)       # ok; pending advances
+    g.preflight("seamA", 300_000)
+    with pytest.raises(ResourceBudgetError) as ei:
+        g.preflight("seamB", 500_000)   # 400k remaining < 500k estimate
+    assert ei.value.errno == errno.ENOSPC and ei.value.seam == "seamB"
+    snap = g.snapshot()
+    assert snap["pending_bytes"] == 600_000
+    assert snap["denied_writes"] == {"seamB": 1}
+
+
+def test_min_free_constraint_uses_statvfs(tmp_path):
+    g = _governor(tmp_path, disk_budget_bytes=0, min_free_bytes=2**62)
+    assert g.submits_shed()             # no real disk has 4 EiB free
+    with pytest.raises(ResourceBudgetError):
+        g.preflight("any", 1)
+    g2 = _governor(tmp_path, disk_budget_bytes=0, min_free_bytes=1)
+    g2.preflight("any", 1)              # any sane test box clears 1 byte
+
+
+def test_disabled_governor_is_inert(tmp_path):
+    g = _governor(tmp_path, disk_budget_bytes=0, min_free_bytes=0)
+    assert not g.enabled
+    g.preflight("x", 2**62)             # nothing to enforce
+    assert g.trace_gate() and g.allow_cache() and not g.submits_shed()
+
+
+def test_module_gates_noop_without_governor():
+    res_mod.preflight("x", 2**62)
+    assert res_mod.allow_cache()
+
+
+# -------------------------------------------------------------- retention GC
+def _age(path: Path, seconds: float = 3600.0) -> None:
+    old = time.time() - seconds
+    os.utime(path, (old, old))
+
+
+def test_gc_retention_classes_and_shard_scoping(tmp_path):
+    g = _governor(tmp_path, done_retention_age_s=10.0,
+                  failed_retention_age_s=10.0,
+                  registry_retention_age_s=10.0)
+    q = tmp_path / "queue"
+    for sub in ("done", "failed", "quarantine", "replicas"):
+        (q / sub).mkdir(parents=True, exist_ok=True)
+    aged_owned = q / "done" / "old_owned.json"
+    aged_peer = q / "done" / "old_peer.json"
+    fresh = q / "done" / "fresh.json"
+    dead_letter = q / "failed" / "old_dl.json"
+    quarantined = q / "quarantine" / "old_q.json"
+    dead_replica = q / "replicas" / "r9.json"
+    for p in (aged_owned, aged_peer, fresh, dead_letter, quarantined,
+              dead_replica):
+        p.write_text(json.dumps({"x": 1}))
+    for p in (aged_owned, aged_peer, dead_letter, quarantined, dead_replica):
+        _age(p)
+    g.gc_tick(owns_msg=lambda mid: mid != "old_peer")
+    assert not aged_owned.exists()
+    assert aged_peer.exists()           # a peer's shard — not ours to reap
+    assert fresh.exists()               # age gate
+    assert not dead_letter.exists() and not quarantined.exists()
+    assert not dead_replica.exists()
+    snap = g.snapshot()
+    assert snap["gc"]["classes"]["done"]["files"] == 1
+    assert snap["gc"]["classes"]["failed"]["files"] == 2
+    assert snap["gc"]["classes"]["registry"]["files"] == 1
+
+
+def test_gc_trace_retention_age_and_size_cap(tmp_path):
+    g = _governor(tmp_path)
+    g.tracing_cfg = TracingConfig(retention_age_s=10.0,
+                                  retention_max_bytes=1500)
+    traces = tmp_path / "work" / "traces"
+    traces.mkdir(parents=True, exist_ok=True)
+    aged = traces / "aged.jsonl"
+    aged.write_text("x" * 100)
+    _age(aged)
+    sized = []
+    for i in range(4):                  # 4 x 1000 B, oldest first past cap
+        p = traces / f"t{i}.jsonl"
+        p.write_text("y" * 1000)
+        _age(p, seconds=5 - i)          # within age retention, distinct mtimes
+        sized.append(p)
+    g.gc_tick()
+    assert not aged.exists()
+    survivors = sorted(p.name for p in traces.glob("*.jsonl"))
+    assert survivors == ["t3.jsonl"]    # 1500 B cap keeps only the newest
+    assert g.snapshot()["gc"]["classes"]["traces"]["files"] == 4
+
+
+def test_gc_cache_size_cap_oldest_shards_first(tmp_path):
+    g = _governor(tmp_path, cache_retention_max_bytes=2500)
+    cache = tmp_path / "cache"
+    cache.mkdir()
+    g.cache_dir = cache
+    shards = []
+    for i in range(4):
+        p = cache / f"theor_peaks_k_{i}.npz"
+        p.write_bytes(b"z" * 1000)
+        _age(p, seconds=40 - i)
+        shards.append(p)
+    stale_tmp = cache / "tmp_deadbeef.npz"
+    stale_tmp.write_bytes(b"t")
+    _age(stale_tmp)
+    g.gc_tick()
+    assert not stale_tmp.exists()
+    left = sorted(p.name for p in cache.glob("theor_peaks_*.npz"))
+    assert left == ["theor_peaks_k_2.npz", "theor_peaks_k_3.npz"]
+
+
+# --------------------------------------------------------- 507 admission shed
+def test_admission_sheds_507_when_disk_exhausted(tmp_path):
+    from sm_distributed_tpu.service.admission import AdmissionController
+
+    g = _governor(tmp_path)
+    _fill(tmp_path, 900_000)
+    g.rescan_usage()
+    res_mod.set_governor(g)
+    adm = AdmissionController(AdmissionConfig(retry_after_s=2.5))
+    d = adm.try_admit("tenant1")
+    assert not d.accepted and d.status == 507
+    assert d.reason == "disk_exhausted" and d.retry_after_s == 2.5
+    assert "retry_after_s" in d.body() and "error" in d.body()
+    # space freed -> admissions resume
+    (tmp_path / "work" / "filler.bin").unlink()
+    g.rescan_usage()
+    assert adm.try_admit("tenant1").accepted
+
+
+# ------------------------------------------------------ tracing gate plumbing
+def test_trace_file_gate_drops_file_writes_not_ring(tmp_path):
+    g = _governor(tmp_path)
+    _fill(tmp_path, 500_000)            # level 1: traces dropped
+    g.rescan_usage()
+    tracing.set_file_gate(g.trace_gate)
+    ctx = tracing.new_trace(job_id="j", trace_dir=tmp_path / "traces")
+    with tracing.attach(ctx), tracing.span("gated"):
+        pass
+    assert not tracing.trace_path(tmp_path / "traces",
+                                  ctx.trace_id).exists()
+    assert any(r.get("name") == "gated"
+               for r in tracing.flight_recorder.recent(64))
+    assert g.snapshot()["degraded_writes"]["trace"] >= 1
