@@ -46,6 +46,7 @@ sys.path.insert(0, str(REPO_ROOT))
 # import every module hosting an injection seam so the registry is complete
 import sm_distributed_tpu.io.imzml  # noqa: F401,E402
 import sm_distributed_tpu.models.msm_basic  # noqa: F401,E402
+import sm_distributed_tpu.service.fleet  # noqa: F401,E402
 import sm_distributed_tpu.service.scheduler  # noqa: F401,E402
 from sm_distributed_tpu.engine.daemon import (  # noqa: E402
     QUEUE_ANNOTATE,
@@ -218,6 +219,29 @@ SCENARIOS: list[Scenario] = [
     Scenario("trace.append", "consume", "trace.append=raise:OSError@1",
              "trace-file write fault (ENOSPC family) is swallowed — "
              "observability degrades, the job completes golden"),
+    # --- elastic-fleet drain seams (ISSUE 11) --------------------------
+    # SM_CHAOS_DRAIN=1 makes the consume subprocess request a drain on
+    # ITSELF once a claim exists, driving the zero-loss drain protocol
+    # through the same scheduler a fleet controller would
+    Scenario("drain.handoff", "consume", "drain.handoff=crash@1",
+             "victim killed mid-drain while holding a claim; takeover "
+             "fences + requeues it and the work completes exactly once",
+             env={"SM_CHAOS_DRAIN": "1"},
+             # fast replica-loop ticks: the drain is noticed (and the crash
+             # lands) while the claim is demonstrably still in flight
+             sm={"service": {"replica_heartbeat_interval_s": 0.1,
+                             "takeover_interval_s": 0.1}}),
+    Scenario("fleet.retire_ack", "consume", "fleet.retire_ack=crash@1",
+             "drained replica dies before its retire ack; the job is "
+             "already terminal — the controller falls back to process-exit "
+             "evidence and nothing is lost or doubled",
+             env={"SM_CHAOS_DRAIN": "1"},
+             sm={"service": {"replica_heartbeat_interval_s": 0.1,
+                             "takeover_interval_s": 0.1}}),
+    Scenario("fleet.spawn", "fleet", "fleet.spawn=crash@1",
+             "fleet controller killed mid-spawn (no replica launched); the "
+             "restarted controller repairs the fleet and the job completes "
+             "exactly once"),
 ]
 
 SMOKE = ("ckpt.shard_write", "spool.complete", "storage.results_rename")
@@ -244,9 +268,64 @@ def cmd_consume_one(queue_dir: str, sm_config_path: str) -> int:
     sched = JobScheduler(queue_dir, annotate_callback(sm), config=sm.service,
                          trace_dir=sm.trace_dir)
     sched.start()
+    drain_mode = os.environ.get("SM_CHAOS_DRAIN") == "1"
+    if drain_mode:
+        # elastic-fleet drain seams (ISSUE 11): once this replica holds a
+        # claim, ask it to drain — exactly what a fleet controller's
+        # scale-down does — so drain.handoff / fleet.retire_ack execute
+        # with real in-flight work
+        deadline = time.time() + 30.0
+        while time.time() < deadline and sched.live_claims() == 0:
+            time.sleep(0.02)
+        sched.registry.request_drain(sched.replica_id, by="chaos")
     ok = sched.wait_for_terminal(1, timeout_s=60.0)
+    if drain_mode:
+        # hold the process open through the ack so the fleet.retire_ack
+        # seam executes before shutdown tears the replica loop down
+        deadline = time.time() + 15.0
+        while time.time() < deadline and not sched.drain_complete():
+            time.sleep(0.05)
     sched.shutdown()
     return 0 if ok else 3
+
+
+def cmd_fleet_one(queue_dir: str, sm_config_path: str) -> int:
+    """Drain one job through a FleetController-supervised replica: the
+    controller (THIS process — crashable at ``fleet.spawn``) spawns one
+    ``--consume-one`` subprocess as its fleet and waits for the job."""
+    from sm_distributed_tpu.analysis import lockorder
+
+    lockorder.enable_from_env()
+    from sm_distributed_tpu.service.fleet import FleetController
+    from sm_distributed_tpu.utils.config import FleetConfig, SMConfig
+
+    sm = SMConfig.set_path(sm_config_path)
+    root = Path(queue_dir) / QUEUE_ANNOTATE
+
+    def _spawn(rid: str) -> subprocess.Popen:
+        # the child is a plain consume-one replica; it inherits the armed
+        # spec harmlessly (it never reaches the controller's spawn seam)
+        return subprocess.Popen(
+            [sys.executable, str(Path(__file__).resolve()),
+             "--consume-one", queue_dir, sm_config_path],
+            cwd=str(REPO_ROOT))
+
+    fc = FleetController(
+        queue_dir, FleetConfig(min_replicas=1, max_replicas=1,
+                               decide_interval_s=0.2, cooldown_s=0.0,
+                               hysteresis_ticks=1, spawn_timeout_s=30.0,
+                               drain_timeout_s=10.0),
+        sm.service, spawn=_spawn)
+    fc.start()
+    try:
+        deadline = time.time() + 90.0
+        while time.time() < deadline:
+            if list((root / "done").glob("*.json")):
+                return 0
+            time.sleep(0.1)
+        return 3
+    finally:
+        fc.shutdown(drain=False, timeout_s=5.0)
 
 
 def cmd_publish_one(queue_dir: str, msg_path: str) -> int:
@@ -433,10 +512,12 @@ def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
         QueuePublisher(ctx.queue_dir).publish(msg)
 
     while result["runs"] < MAX_RUNS:
-        armed = sc.phase == "consume" and result["runs"] < sc.spec_runs
+        armed = sc.phase in ("consume", "fleet") and \
+            result["runs"] < sc.spec_runs
         spec = sc.spec if armed else None
+        sub = "--fleet-one" if sc.phase == "fleet" else "--consume-one"
         rc, out = _run_sub(
-            ["--consume-one", str(ctx.queue_dir), str(ctx.sm_conf)], spec,
+            [sub, str(ctx.queue_dir), str(ctx.sm_conf)], spec,
             sc.env)
         outputs.append(out)
         result["runs"] += 1
@@ -598,12 +679,15 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("-v", "--verbose", action="store_true")
     ap.add_argument("--consume-one", nargs=2, metavar=("QUEUE_DIR", "SM_CONFIG"))
     ap.add_argument("--publish-one", nargs=2, metavar=("QUEUE_DIR", "MSG_JSON"))
+    ap.add_argument("--fleet-one", nargs=2, metavar=("QUEUE_DIR", "SM_CONFIG"))
     args = ap.parse_args(argv)
 
     if args.consume_one:
         return cmd_consume_one(*args.consume_one)
     if args.publish_one:
         return cmd_publish_one(*args.publish_one)
+    if args.fleet_one:
+        return cmd_fleet_one(*args.fleet_one)
     if args.list_fps:
         for name, desc in sorted(failpoints.registered_failpoints().items()):
             print(f"{name:<26} {desc}")

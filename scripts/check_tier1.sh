@@ -110,6 +110,16 @@ if ! env JAX_PLATFORMS=cpu python scripts/replica_chaos.py --smoke; then
     exit 1
 fi
 
+# elastic-fleet smoke gate (ISSUE 11): a lock-order-instrumented
+# FleetController over bare replica subprocesses must scale 1→4 under a
+# traffic surge and drain back to 2 under cooldown, with every job done/
+# exactly once, bounded p99 queue-wait, zero orphaned leases/heartbeats
+# from drained replicas, and sm_fleet_* metric families exposed
+if ! env JAX_PLATFORMS=cpu python scripts/load_sweep.py --elastic; then
+    echo "check_tier1: FAIL — elastic-fleet smoke gate failed" >&2
+    exit 1
+fi
+
 # perf-sentinel self-check (ISSUE 6): the regression gate itself is gated —
 # the newest committed BENCH_r*.json must pass against its own history AND
 # a synthetically degraded copy must trip the sentinel

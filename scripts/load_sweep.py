@@ -653,35 +653,197 @@ def mix_replicas(base: Path, n_jobs: int = 600, tenant_space: int = 10_000,
           f"worst bucket mean {worst:.2f}s")
 
 
+def mix_elastic(base: Path, n_jobs: int = 420, p99_bound_s: float = 30.0) -> None:
+    """Elastic-fleet wave (ISSUE 11 proof; ROADMAP item 2).
+
+    A FleetController (in-process, lock-order-instrumented) supervises bare
+    scheduler replicas (``replica_chaos.py --replica-serve --bare`` — null
+    jobs; this mix measures the SCALING plane) over one partitioned spool.
+    A pre-published traffic surge drives the fleet 1→4 autonomously; as the
+    queue drains, cooldown-damped scale-downs *drain* replicas back — the
+    mix observes the fleet at 2 before stopping.  Asserts: every job
+    reaches ``done/`` exactly once (replica_chaos's exactly-once census),
+    p99 queue-wait bounded, drained replicas leave zero orphaned
+    leases/heartbeat/registry files, and the ``sm_fleet_*`` families are
+    exposed."""
+    import subprocess
+
+    from scripts.replica_chaos import _spool_census
+    from sm_distributed_tpu.engine.daemon import QUEUE_ANNOTATE, QueuePublisher
+    from sm_distributed_tpu.service.fleet import FleetController
+    from sm_distributed_tpu.service.metrics import MetricsRegistry
+    from sm_distributed_tpu.utils.config import FleetConfig
+
+    mix_dir = base / "elastic"
+    queue_dir = mix_dir / "queue"
+    root = queue_dir / QUEUE_ANNOTATE
+    sm = {
+        "backend": "numpy_ref",
+        "work_dir": str(mix_dir / "work"),
+        "storage": {"results_dir": str(mix_dir / "results")},
+        "service": {
+            "workers": 2, "poll_interval_s": 0.02, "job_timeout_s": 30.0,
+            "max_attempts": 2, "backoff_base_s": 0.05, "backoff_max_s": 0.2,
+            "backoff_jitter": 0.0, "heartbeat_interval_s": 0.2,
+            "stale_after_s": 1.0, "drain_timeout_s": 20.0, "http_port": 0,
+            "replicas": 4, "spool_shards": 16,
+            # claim churn during membership changes bumps claim counters;
+            # keep quarantine out of the way (same rationale as
+            # replica_chaos's template — the mix is elasticity, not poison)
+            "quarantine_after": 50,
+            "replica_heartbeat_interval_s": 0.1,
+            "replica_stale_after_s": 1.0, "takeover_interval_s": 0.2,
+        },
+    }
+    mix_dir.mkdir(parents=True, exist_ok=True)
+    sm_conf = mix_dir / "sm.json"
+    sm_conf.write_text(json.dumps(sm, indent=2))
+    pub = QueuePublisher(queue_dir)
+    t_publish = time.time()
+    for i in range(n_jobs):
+        pub.publish({"ds_id": f"ej{i}", "msg_id": f"ej{i:05d}",
+                     "input_path": "null://", "tenant": f"t{i % 97}"})
+    script = str(REPO_ROOT / "scripts" / "replica_chaos.py")
+    env = dict(__import__("os").environ)
+    env.pop("SM_FAILPOINTS", None)
+    logs = []
+
+    def _spawn(rid: str) -> subprocess.Popen:
+        log = open(mix_dir / f"{rid}.log", "w")
+        logs.append(log)
+        # long idle-exit: replicas retire by DRAIN, not by queue idleness
+        return subprocess.Popen(
+            [sys.executable, script, "--replica-serve", str(queue_dir),
+             str(sm_conf), "--replica-id", rid, "--bare",
+             "--null-sleep", "0.05", "--idle-exit", "120"],
+            env=env, stdout=log, stderr=log, cwd=str(REPO_ROOT))
+
+    registry = MetricsRegistry()
+    from sm_distributed_tpu.utils.config import SMConfig as _SM
+
+    fc = FleetController(
+        queue_dir,
+        FleetConfig(min_replicas=1, max_replicas=4, decide_interval_s=0.15,
+                    cooldown_s=1.0, hysteresis_ticks=2, scale_up_burn=1.0,
+                    scale_down_burn=0.5, queue_high_per_replica=20.0,
+                    queue_low_per_replica=0.5, spawn_timeout_s=30.0,
+                    drain_timeout_s=30.0),
+        _SM.from_dict(json.loads(sm_conf.read_text())).service,
+        spawn=_spawn, metrics=registry)
+    max_alive = 0
+    saw_two_after_peak = False
+    try:
+        fc.start()
+        deadline = time.time() + 240.0
+        while time.time() < deadline:
+            alive = len(fc.alive_replicas())
+            max_alive = max(max_alive, alive)
+            done = len(list((root / "done").glob("*.json")))
+            if done >= n_jobs and max_alive >= 4 and alive <= 2:
+                saw_two_after_peak = True
+                break
+            time.sleep(0.05)
+        _check(saw_two_after_peak,
+               f"elastic: never observed surge→4→2 "
+               f"(max_alive={max_alive}, "
+               f"done={len(list((root / 'done').glob('*.json')))}/{n_jobs}, "
+               f"status={fc.status()})")
+    finally:
+        fc.shutdown()
+        for log in logs:
+            log.close()
+    st = fc.status()
+    _check(st["scale_events"]["up"] >= 3,
+           f"elastic: expected >=3 scale-ups, got {st['scale_events']}")
+    _check(st["drains_total"] >= 2,
+           f"elastic: expected >=2 completed drains, got {st}")
+    _check(st["crashes_total"] == 0,
+           f"elastic: controller counted crashes: {st}")
+    # exactly-once: every job in done/ once, nothing anywhere else
+    # (replica_chaos's census invariant)
+    census = _spool_census(root)
+    want = sorted(f"ej{i:05d}" for i in range(n_jobs))
+    _check(census["done"] == want,
+           f"elastic: done/ census mismatch "
+           f"({len(census['done'])}/{n_jobs} done)")
+    others = {s: v for s, v in census.items() if s != "done" and v}
+    _check(not others, f"elastic: messages left outside done/: "
+                       f"{ {s: len(v) for s, v in others.items()} }")
+    # drained replicas must leave no orphaned leases / heartbeats /
+    # registry debris — the zero-loss drain's cleanliness contract
+    leases_left = sorted(p.name for p in (root / "leases").glob("*.json"))
+    _check(not leases_left, f"elastic: leftover lease files: {leases_left}")
+    beats_left = sorted(p.name for p in (root / "replicas").glob("*.json"))
+    _check(not beats_left,
+           f"elastic: drained replicas left heartbeat files: {beats_left}")
+    drains_left = sorted(p.name for p in (root / "replicas").glob("*.drain"))
+    _check(not drains_left,
+           f"elastic: drain sentinels not cleaned: {drains_left}")
+    hb_left = [str(p) for p in root.rglob("*.hb")]
+    _check(not hb_left, f"elastic: claim heartbeat debris: {hb_left}")
+    # queue-wait bound under the surge (scheduler stamps claimed_at)
+    waits = []
+    for p in (root / "done").glob("*.json"):
+        msg = json.loads(p.read_text())
+        w = (float(msg.get("service", {}).get("claimed_at", 0.0))
+             - float(msg["published_at"]))
+        _check(w >= 0, f"elastic: negative queue wait on {p.name}")
+        waits.append(w)
+    waits.sort()
+    p50 = waits[len(waits) // 2]
+    p99 = waits[min(len(waits) - 1, int(len(waits) * 0.99))]
+    _check(p99 <= p99_bound_s,
+           f"elastic: p99 queue wait {p99:.2f}s > bound {p99_bound_s}s")
+    # the acceptance metrics are exposed by the controller's registry (on
+    # the hosting replica's /metrics under serve --fleet)
+    text = registry.expose()
+    for fam in ("sm_fleet_replicas", "sm_fleet_scale_events_total",
+                "sm_fleet_drains_total"):
+        _check(fam in text, f"elastic: {fam} missing from metrics")
+    drain_s = time.time() - t_publish
+    print(f"  elastic: {n_jobs} jobs; fleet 1→{max_alive}→2 "
+          f"({st['scale_events']['up']} ups, {st['drains_total']} drains, "
+          f"0 crashes); drain {drain_s:.1f}s, queue-wait p50 {p50:.2f}s "
+          f"p99 {p99:.2f}s")
+
+
 # ------------------------------------------------------------------- driver
-def run_sweep(work: Path, smoke: bool = False) -> int:
+def run_sweep(work: Path, smoke: bool = False,
+              elastic_only: bool = False) -> int:
     # lock-order detection (ISSUE 9): instrument every lock the service
     # stack creates below and fail the sweep on an acquisition-order cycle
     # — the load mixes drive scheduler workers, dispatcher, watchdog,
-    # admission, device pool, and telemetry concurrently, which is exactly
-    # the thread population a lurking inversion needs
+    # admission, device pool, telemetry, AND the fleet controller
+    # concurrently, which is exactly the thread population a lurking
+    # inversion needs
     from sm_distributed_tpu.analysis import lockorder
 
     lockorder.enable()
     work.mkdir(parents=True, exist_ok=True)
-    fx = build_fixtures(work)
     t0 = time.time()
     try:
-        h = Harness(work, "main")
-        try:
-            print(f"load sweep ({'smoke' if smoke else 'full'}) at {h.base}")
-            mix_burst(h, fx, n_submit=(12 if smoke else 24))
+        if elastic_only:
+            print("load sweep (elastic-fleet stage)")
+            mix_elastic(work)
+        else:
+            fx = build_fixtures(work)
+            h = Harness(work, "main")
+            try:
+                print(f"load sweep ({'smoke' if smoke else 'full'}) "
+                      f"at {h.base}")
+                mix_burst(h, fx, n_submit=(12 if smoke else 24))
+                if not smoke:
+                    mix_sustained(h, fx, n_submit=10, gap_s=0.1)
+                    mix_cancel(h, fx)
+                mix_deadline(h, fx)
+                mix_poison(h, fx)
+            finally:
+                h.shutdown()
             if not smoke:
-                mix_sustained(h, fx, n_submit=10, gap_s=0.1)
-                mix_cancel(h, fx)
-            mix_deadline(h, fx)
-            mix_poison(h, fx)
-        finally:
-            h.shutdown()
-        if not smoke:
-            mix_breaker(work, fx)
-            mix_disk(work, fx)
-            mix_replicas(work)
+                mix_breaker(work, fx)
+                mix_disk(work, fx)
+                mix_replicas(work)
+                mix_elastic(work)
         rep = lockorder.assert_no_cycles("load sweep")
         print(f"lock-order: no cycles ({rep['locks_instrumented']} locks, "
               f"{rep['edges']} order edges observed)")
@@ -695,6 +857,9 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--smoke", action="store_true",
                     help="CI subset: burst + deadline + poison")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run only the elastic-fleet mix (1→4→2 wave with "
+                         "exactly-once + clean-drain asserts)")
     ap.add_argument("--work", default=None)
     ap.add_argument("--keep", action="store_true")
     args = ap.parse_args(argv)
@@ -704,7 +869,7 @@ def main(argv: list[str] | None = None) -> int:
     work = Path(args.work) if args.work else Path(
         tempfile.mkdtemp(prefix="sm_load_"))
     try:
-        return run_sweep(work, smoke=args.smoke)
+        return run_sweep(work, smoke=args.smoke, elastic_only=args.elastic)
     except SweepError as exc:
         print(f"load sweep FAILED: {exc}", file=sys.stderr)
         return 1

@@ -175,6 +175,10 @@ def cmd_replica_serve(args) -> int:
             root = Path(args.queue_dir) / QUEUE_ANNOTATE
             idle_since = None
             while True:
+                if sched.drain_complete():
+                    # zero-loss drain (ISSUE 11): the fleet controller asked
+                    # this replica to retire and every claim resolved
+                    break
                 busy = (len(list(root.glob("pending/*.json")))
                         + len(list(root.glob("running/*.json"))))
                 if busy:

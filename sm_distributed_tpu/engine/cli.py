@@ -168,8 +168,33 @@ def cmd_serve(args) -> int:
         host, port = service.api.address
         logger.info("serve: admin API on http://%s:%d "
                     "(/healthz /metrics /jobs POST /submit)", host, port)
-    return service.run_forever(max_terminal=args.max_jobs,
-                               idle_timeout_s=args.idle_timeout)
+    controller = None
+    if args.fleet or sm_config.service.fleet.enabled:
+        # elastic fleet (docs/SERVICE.md "Elasticity model"): THIS process
+        # is replica r0 AND hosts the controller; additional replicas are
+        # spawned `serve` subprocesses over the same spool, with their own
+        # controllers disabled.  The controller's sm_fleet_* metrics land
+        # on this replica's /metrics.
+        from ..service.fleet import (
+            FleetController,
+            serve_spawn,
+            service_signals,
+            write_child_config,
+        )
+
+        child_conf = write_child_config(sm_config, sm_config.work_dir)
+        controller = FleetController(
+            args.queue_dir, sm_config.service.fleet, sm_config.service,
+            spawn=serve_spawn(args.queue_dir, child_conf),
+            signals=service_signals(service), metrics=service.metrics,
+            self_replica_id=sm_config.service.replica_id)
+        controller.start()
+    try:
+        return service.run_forever(max_terminal=args.max_jobs,
+                                   idle_timeout_s=args.idle_timeout)
+    finally:
+        if controller is not None:
+            controller.shutdown()
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -225,6 +250,12 @@ def build_parser() -> argparse.ArgumentParser:
     srv.add_argument("--shards", type=int, default=None,
                      help="override service.spool_shards (logical spool "
                           "partitions; must match across replicas)")
+    srv.add_argument("--fleet", action="store_true",
+                     help="run the elastic-fleet controller beside this "
+                          "replica: spawn/drain serve subprocesses between "
+                          "service.fleet.min_replicas and max_replicas on "
+                          "SLO burn + queue depth (docs/SERVICE.md "
+                          "'Elasticity model')")
     srv.add_argument("--no-api", action="store_true",
                      help="run the scheduler without the admin API")
     srv.add_argument("--max-jobs", type=int, default=None,

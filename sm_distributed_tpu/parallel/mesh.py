@@ -81,12 +81,44 @@ def resolve_axis_sizes(n_devices: int, cfg: ParallelConfig) -> tuple[int, int]:
     return pix, form
 
 
-def make_mesh(cfg: ParallelConfig, devices=None) -> Mesh:
-    """Build the ("pixels", "formulas") mesh from config + available devices."""
+def make_mesh(cfg: ParallelConfig, devices=None, hosts: int = 1) -> Mesh:
+    """Build the ("pixels", "formulas") mesh from config + available devices.
+
+    ``hosts`` (ISSUE 11) declares the host×chip topology the device list
+    came from (a ``jax.distributed``-style multi-host pool, simulated on
+    CPU).  The device order is host-major, so with ``hosts`` dividing the
+    pixels axis each host's chips form a contiguous block of pixel shards
+    — cross-host (DCN) traffic is confined to the pixel-axis reductions
+    and a whole-host failure takes out a contiguous, re-computable shard
+    range instead of a stripe through every shard.  A topology the grid
+    cannot honor is logged and ignored (topology is an optimization, never
+    a reason to fail the job)."""
     devices = list(devices if devices is not None else jax.devices())
     pix, form = resolve_axis_sizes(len(devices), cfg)
+    if hosts > 1:
+        from ..utils.logger import logger
+
+        if pix % hosts:
+            logger.warning(
+                "make_mesh: %d hosts does not divide the %d-shard pixels "
+                "axis; host blocks will straddle mesh rows", hosts, pix)
+        else:
+            logger.info("make_mesh: %dx%d mesh over %d host(s) "
+                        "(%d pixel shard(s) per host)",
+                        pix, form, hosts, pix // hosts)
     dev_grid = np.array(devices[: pix * form]).reshape(pix, form)
     return Mesh(dev_grid, (PIXELS_AXIS, FORMULAS_AXIS))
+
+
+def host_topology(device_indices, chips_per_host: int) -> dict[int, tuple]:
+    """Group a lease's chip indices by host failure domain:
+    ``{host: (chip, ...)}`` — what the fleet controller (and a sub-mesh
+    lease) uses to reason about host-level blast radius."""
+    cph = max(1, int(chips_per_host))
+    out: dict[int, list[int]] = {}
+    for i in device_indices or ():
+        out.setdefault(int(i) // cph, []).append(int(i))
+    return {h: tuple(sorted(v)) for h, v in sorted(out.items())}
 
 
 def lease_devices(device_indices) -> list | None:

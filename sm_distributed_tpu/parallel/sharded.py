@@ -660,12 +660,27 @@ def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig,
 
     maybe_initialize_distributed(sm_config.parallel)  # no-op single-process
     devices = lease_devices(device_indices)
+    # host×chip topology of the lease (ISSUE 11): the pool hands out chip
+    # indices host-major, so the sub-mesh can confine cross-host (DCN)
+    # traffic to pixel-axis boundaries; `hosts` here is how many host
+    # failure domains THIS lease spans, not the whole pool's
+    hosts = 1
+    pool_hosts = max(1, int(getattr(sm_config.service,
+                                    "device_pool_hosts", 1)))
+    if devices is not None and device_indices is not None and pool_hosts > 1:
+        from ..service.device_pool import resolve_pool_size
+        from .mesh import host_topology
+
+        pool_size = resolve_pool_size(sm_config.service)
+        if pool_size % pool_hosts == 0:
+            hosts = max(1, len(host_topology(
+                device_indices, pool_size // pool_hosts)))
     if devices is not None and len(devices) == 1:
         from ..models.msm_jax import JaxBackend
 
         return JaxBackend(ds, ds_config, sm_config,
                           restrict_table=restrict_table, device=devices[0])
-    mesh = make_mesh(sm_config.parallel, devices=devices)
+    mesh = make_mesh(sm_config.parallel, devices=devices, hosts=hosts)
     if mesh.size == 1:
         from ..models.msm_jax import JaxBackend
 

@@ -27,6 +27,11 @@ shape the ROADMAP north star asks for on top of the same spool contract:
   and error-budget burn served by ``GET /slo``;
 - ``api``        — stdlib ``http.server`` admin API (``/healthz``,
   ``/metrics``, ``/jobs``, ``POST /submit``, ``DELETE /jobs/<id>``);
+- ``fleet``      — elastic replica fleet (docs/SERVICE.md "Elasticity
+  model"): a FleetController supervising replica subprocesses, scaling
+  between ``fleet.min_replicas`` and ``fleet.max_replicas`` on /slo
+  error-budget burn + queue depth + pool occupancy, with zero-loss drain
+  on scale-down and crash-vs-drain discrimination;
 - ``server``     — ``AnnotationService`` composing all of the above (plus
   the device circuit breaker, ``models/breaker.py``) with graceful SIGTERM
   shutdown (drain running, requeue claimed-but-unstarted).
@@ -40,6 +45,7 @@ callbacks — see ``tests/test_service.py``.
 
 from .admission import AdmissionController
 from .device_pool import DeviceLease, DevicePool
+from .fleet import FleetController, FleetSignals, FleetState
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .scheduler import JobRecord, JobScheduler, RetryPolicy
 from .server import AnnotationService
@@ -52,6 +58,9 @@ __all__ = [
     "DeviceLease",
     "DeviceMonitor",
     "DevicePool",
+    "FleetController",
+    "FleetSignals",
+    "FleetState",
     "Gauge",
     "Histogram",
     "JobRecord",

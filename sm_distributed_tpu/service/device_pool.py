@@ -64,6 +64,13 @@ class DeviceLease:
         self._queued = False
         self._waiting_since = 0.0
 
+    @property
+    def hosts(self) -> tuple[int, ...]:
+        """Host failure domains this grant spans (ISSUE 11): empty while
+        ungranted, one host for packed small jobs, several for a sub-mesh
+        lease spanning the host dimension."""
+        return tuple(sorted({self.pool.host_of(i) for i in self.devices}))
+
     # ------------------------------------------------- lock protocol
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         return self.pool._acquire(self, blocking, timeout)
@@ -96,23 +103,40 @@ class DevicePool:
     # are the documented caller-holds-lock exception)
     _GUARDED_BY = {"_owner": "_cond", "_waiters": "_cond",
                    "_compat": "_cond", "grants_total": "_cond",
-                   "releases_total": "_cond"}
+                   "releases_total": "_cond", "leases_reaped_total": "_cond"}
 
-    def __init__(self, size: int, max_bypass: int = 64):
+    def __init__(self, size: int, max_bypass: int = 64, hosts: int = 1):
         if size <= 0:
             raise ValueError(f"device pool size must be positive, got {size}")
         self.size = int(size)
         self.max_bypass = max(0, int(max_bypass))
+        # host dimension (ISSUE 11): the pool's chips split into `hosts`
+        # equal failure domains — the jax.distributed host×chip topology,
+        # simulated on CPU.  Grants PREFER a run within one host (a
+        # single-host sub-mesh has no cross-host collectives and dies with
+        # exactly one host); a lease wider than a host spans hosts and
+        # reports them.  A non-dividing host count degrades to 1 host
+        # rather than failing the pool — topology is an optimization.
+        hosts = max(1, int(hosts))
+        if self.size % hosts:
+            logger.warning(
+                "device pool: %d hosts does not divide %d chips; treating "
+                "the pool as a single host", hosts, self.size)
+            hosts = 1
+        self.hosts = hosts
+        self.chips_per_host = self.size // hosts
         self._cond = threading.Condition()
         self._owner: list[DeviceLease | None] = [None] * self.size
         self._waiters: list[DeviceLease] = []
         self._compat: list[DeviceLease] = []   # legacy single-token grants
         self.grants_total = 0
         self.releases_total = 0
+        self.leases_reaped_total = 0
         self._m_grants = None
         self._m_wait = None
         self._m_in_use = None
         self._m_waiters = None
+        self._m_reaped = None
 
     # ------------------------------------------------------------ metrics
     def attach_metrics(self, registry) -> None:
@@ -134,6 +158,14 @@ class DevicePool:
             "Chips in the scheduler's device pool").set(self.size)
         self._m_waiters = registry.gauge(
             "sm_device_pool_waiters", "Leases currently waiting for chips")
+        registry.gauge(
+            "sm_device_pool_hosts",
+            "Host failure domains the pool's chips split into").set(
+            self.hosts)
+        self._m_reaped = registry.counter(
+            "sm_device_pool_leases_reaped_total",
+            "Abandoned-attempt leases reclaimed by the zombie reaper",
+            ("reason",))
 
     # ---------------------------------------------------------- inspection
     def lease(self, n: int, msg_id: str = "") -> DeviceLease:
@@ -157,12 +189,22 @@ class DevicePool:
         with self._cond:
             return len(self._waiters)
 
+    def host_of(self, i: int) -> int:
+        """Host failure domain of chip index ``i``."""
+        return int(i) // self.chips_per_host
+
     def snapshot(self) -> dict:
         """One point-in-time view (telemetry ring / debugging)."""
         with self._cond:
+            per_host = [0] * self.hosts
+            for i, o in enumerate(self._owner):
+                if o is not None:
+                    per_host[i // self.chips_per_host] += 1
             return {
                 "size": self.size,
+                "hosts": self.hosts,
                 "in_use": sum(o is not None for o in self._owner),
+                "per_host_in_use": per_host,
                 "waiters": len(self._waiters),
                 "grants_total": self.grants_total,
                 "holders": {
@@ -172,11 +214,28 @@ class DevicePool:
 
     # ---------------------------------------------------- grant machinery
     def _find_run(self, n: int) -> int | None:
-        """First start index of a contiguous free run of length ``n``."""
+        """First start index of a contiguous free run of length ``n``,
+        preferring a run that stays within ONE host (fewest failure
+        domains, no cross-host collectives); a lease wider than a host —
+        or a pool too fragmented for a single-host run — falls back to any
+        contiguous run spanning the host boundary."""
+        if self.hosts > 1 and n <= self.chips_per_host:
+            single = self._scan_run(n, within_host=True)
+            if single is not None:
+                return single
+        return self._scan_run(n, within_host=False)
+
+    def _scan_run(self, n: int, within_host: bool) -> int | None:
         run = 0
         for i in range(self.size):
-            run = run + 1 if self._owner[i] is None else 0
-            if run == n:
+            if self._owner[i] is None:
+                if within_host and run and \
+                        i % self.chips_per_host == 0:
+                    run = 0           # a host boundary breaks the run
+                run += 1
+            else:
+                run = 0
+            if run >= n:
                 return i - n + 1
         return None
 
@@ -270,6 +329,23 @@ class DevicePool:
                 lease.devices = ()
                 self.releases_total += 1
             self._cond.notify_all()
+
+    def reap(self, lease: DeviceLease, reason: str = "exit") -> None:
+        """Reclaim an abandoned attempt's lease (ISSUE 11 satellite: the
+        zombie-lease leak).  ``reason`` is ``"exit"`` (the zombie thread
+        finished) or ``"ttl"`` (forced after ``lease_reap_after_s``).
+        No-ops when the lease already released itself (idempotent)."""
+        with self._cond:
+            held = bool(lease.devices) or lease._queued
+            if held:
+                self.leases_reaped_total += 1
+        if not held:
+            return
+        lease.release()
+        if self._m_reaped is not None:
+            self._m_reaped.labels(reason=reason).inc()
+        logger.info("device pool: reaped abandoned lease for %s (%s)",
+                    lease.msg_id or "anonymous", reason)
 
     # ------------------------------------- legacy single-token protocol
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:

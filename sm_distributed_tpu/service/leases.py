@@ -346,9 +346,15 @@ class ReplicaRegistry:
         """First beat: epoch = prior epoch + 1 (or 1).  Returns the epoch
         this replica's leases will carry.  A beat I/O fault here does not
         abort registration — epoch persistence is best-effort (the
-        per-message fence counter, not the epoch, is the safety argument)."""
+        per-message fence counter, not the epoch, is the safety argument).
+
+        Any drain request left over from a PREVIOUS incarnation is cleared:
+        a drain addresses an incarnation, not an identity — the controller
+        that wanted the old process gone saw it exit; if it still wants
+        this one gone it re-requests (docs/SERVICE.md "Elasticity model")."""
         prior = self._read(self.replica_id) or {}
         self.epoch = int(prior.get("epoch", 0)) + 1
+        self.clear_drain(self.replica_id)
         try:
             (self.dir / f".{self.replica_id}.json.tmp").unlink(missing_ok=True)
             self.beat()
@@ -381,10 +387,11 @@ class ReplicaRegistry:
             return None
 
     def peers(self, include_self: bool = True) -> list[dict]:
-        """Every registered replica's latest beat, with ``age_s`` and
-        ``alive`` computed against the staleness horizon."""
+        """Every registered replica's latest beat, with ``age_s``,
+        ``alive``, and ``draining`` (a drain sentinel exists) computed."""
         out = []
         now = time.time()
+        draining = self.draining_ids()
         for p in sorted(self.dir.glob("*.json")):
             rec = self._read(p.stem)
             if rec is None:
@@ -394,6 +401,7 @@ class ReplicaRegistry:
             age = now - float(rec.get("beat_at", 0.0))
             rec["age_s"] = round(age, 3)
             rec["alive"] = age < self.stale_after_s
+            rec["draining"] = str(rec.get("replica_id", "")) in draining
             out.append(rec)
         return out
 
@@ -404,6 +412,69 @@ class ReplicaRegistry:
             if rec["alive"]:
                 out.add(str(rec["replica_id"]))
         return out
+
+    def active(self) -> set[str]:
+        """The shard-ownership membership set: alive replicas MINUS those
+        with a drain request.  A draining replica keeps heartbeating (so
+        its in-flight claims are not fenced prematurely) but drops out of
+        rendezvous ownership immediately — peers adopt its shards while it
+        finishes or releases what it already holds (zero-loss drain).
+        NB: ``owned_shards`` unions the caller back in, so a draining
+        replica must special-case its own ownership to the empty set
+        (``JobScheduler._recompute_owned`` does)."""
+        return self.alive() - self.draining_ids()
+
+    # ------------------------------------------------------- drain protocol
+    def _drain_path(self, rid: str) -> Path:
+        return self.dir / f"{rid}.drain"
+
+    def request_drain(self, rid: str, by: str = "") -> None:
+        """Mark ``rid`` draining (the fleet controller's scale-down seam).
+        The sentinel is a separate file so the victim's own heartbeat
+        rewrites never clobber it."""
+        tmp = self.dir / f".{rid}.drain.tmp"
+        tmp.write_text(json.dumps({
+            "replica_id": rid, "requested_at": time.time(), "by": by,
+            "acked_at": 0.0,
+        }))
+        os.replace(tmp, self._drain_path(rid))
+
+    def drain_requested(self, rid: str | None = None) -> bool:
+        return self._drain_path(rid or self.replica_id).exists()
+
+    def ack_drain(self) -> None:
+        """The draining replica's retire ack: all claims finished or
+        released, nothing more will be written — the controller may count
+        the drain complete once the process also exits."""
+        p = self._drain_path(self.replica_id)
+        try:
+            cur = json.loads(p.read_text())
+            if not isinstance(cur, dict):
+                cur = {}
+        except (OSError, ValueError):
+            cur = {"replica_id": self.replica_id}
+        cur["acked_at"] = time.time()
+        cur["epoch"] = self.epoch
+        tmp = self.dir / f".{self.replica_id}.drain.tmp"
+        tmp.write_text(json.dumps(cur))
+        os.replace(tmp, p)
+
+    def drain_acked(self, rid: str) -> bool:
+        try:
+            cur = json.loads(self._drain_path(rid).read_text())
+            return isinstance(cur, dict) and float(cur.get("acked_at", 0)) > 0
+        except (OSError, ValueError):
+            return False
+
+    def clear_drain(self, rid: str) -> None:
+        try:
+            self._drain_path(rid).unlink(missing_ok=True)
+        except OSError:
+            logger.warning("replica registry: could not clear drain "
+                           "sentinel for %s", rid, exc_info=True)
+
+    def draining_ids(self) -> set[str]:
+        return {p.stem for p in self.dir.glob("*.drain")}
 
     def retire(self) -> None:
         """Graceful shutdown: drop out of the alive set immediately so

@@ -93,6 +93,16 @@ FP_CANCEL_DELIVER = register_failpoint(
     "sched.cancel_deliver",
     "between a cancel decision (timeout/deadline/user/watchdog) and its "
     "delivery to the attempt's CancelToken")
+FP_DRAIN_HANDOFF = register_failpoint(
+    "drain.handoff",
+    "inside a replica's drain begin — after the drain request is noticed, "
+    "while claims may still be in flight (a crash here is a victim killed "
+    "mid-drain; takeover must complete its work exactly once)")
+FP_RETIRE_ACK = register_failpoint(
+    "fleet.retire_ack",
+    "between a drained replica going idle and its retire ack write (a "
+    "crash here leaves the ack unwritten; the controller falls back to "
+    "process-exit + registry staleness)")
 
 PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
 
@@ -246,8 +256,9 @@ class JobScheduler:
     # shared-state registry checked by the smlint guarded-by rule
     # (docs/ANALYSIS.md): dispatcher, workers, watchdog, replica loop, and
     # HTTP handlers all touch these maps — mutations only under
-    # _records_lock.  _owned is excluded deliberately: it is replaced
-    # wholesale by one writer (the replica loop) and read racily by design.
+    # _records_lock.  _owned and _draining are excluded deliberately: each
+    # is replaced wholesale by one writer (the replica loop) and read
+    # racily by design.
     _GUARDED_BY = {"_records": "_records_lock", "_live": "_records_lock",
                    "_trace_roots": "_records_lock",
                    "_lease_by_msg": "_records_lock",
@@ -301,7 +312,8 @@ class JobScheduler:
         # poked the PR 1 lock directly.
         self.device_pool = device_pool if device_pool is not None else \
             DevicePool(resolve_pool_size(self.cfg),
-                       max_bypass=self.cfg.device_pool_max_bypass)
+                       max_bypass=self.cfg.device_pool_max_bypass,
+                       hosts=self.cfg.device_pool_hosts)
         self.device_token = self.device_pool
         # multi-replica protocol (ISSUE 8, service/leases.py): this
         # replica's identity in the registry, its epoch-numbered fenced
@@ -318,6 +330,12 @@ class JobScheduler:
         self._lease_by_msg: dict[str, object] = {}
         self._owned: set[int] = set(range(self.cfg.spool_shards))
         self._fenced_count = 0
+        # zero-loss drain (ISSUE 11): once a drain request is noticed the
+        # replica stops claiming (owned = ∅, peers adopt the shards),
+        # finishes or releases in-flight work, acks, and the serve loop
+        # exits.  _draining is replica-loop-written, read racily.
+        self._draining = False
+        self._drain_done = threading.Event()
         self._records: dict[str, JobRecord] = {}
         self._records_lock = threading.Lock()
         # live attempts by msg_id: (CancelToken, _Attempt) — the seam the
@@ -480,10 +498,14 @@ class JobScheduler:
     # ------------------------------------------------------------ replicas
     def _recompute_owned(self) -> set[int]:
         """Shards this replica owns right now: rendezvous hashing over the
-        alive replica set (self always included).  A dead peer's shards
-        land here the moment its heartbeat passes the staleness horizon."""
-        owned = owned_shards(self.replica_id, self.registry.alive(),
-                             self.cfg.spool_shards)
+        ACTIVE replica set (alive minus draining; self included unless
+        draining).  A dead peer's shards land here the moment its heartbeat
+        passes the staleness horizon; a draining peer's land here the
+        moment its drain sentinel appears — while the victim's fresh
+        heartbeats keep its in-flight claims safe from takeover."""
+        owned = (set() if self._draining else
+                 owned_shards(self.replica_id, self.registry.active(),
+                              self.cfg.spool_shards))
         prev = self._owned
         self._owned = owned
         gained = owned - prev
@@ -513,8 +535,14 @@ class JobScheduler:
             "shards": self.cfg.spool_shards,
             "owned": sorted(self._owned),
             "fenced_claims": self._fenced_count,
+            "draining": self._draining,
             "replicas": self.registry.peers(),
         }
+
+    def live_claims(self) -> int:
+        """Claims this replica currently holds (claimed or running)."""
+        with self._records_lock:
+            return len(self._lease_by_msg)
 
     def peer_admission_summaries(self) -> list[dict]:
         """Alive PEER replicas' admission summaries (excl. self) — the
@@ -529,6 +557,9 @@ class JobScheduler:
         messages in OWNED shards are read at all — the shard filter works
         on the filename, so a replica never pays I/O for its peers'
         partitions."""
+        if self._draining:
+            return []                 # draining: claim nothing new, not
+                                      # even orphan rescues — peers own it
         out = []
         with self._records_lock:
             inflight = dict(self._inflight_by_tenant)
@@ -609,7 +640,7 @@ class JobScheduler:
         so the next admission re-scans with FRESH fairness keys (per-tenant
         in-flight counts move with every claim)."""
         for _key, p, msg in self._scan_pending(time.time()):
-            if self._stop.is_set():
+            if self._stop.is_set() or self._draining:
                 return False
             claimed = self._claim(p)
             if claimed is None:
@@ -899,13 +930,16 @@ class JobScheduler:
                     # invariant: a dead attempt never holds chips)
                     lease.release()
                 elif lease.locked():
-                    # abandoned zombie still computing: leaking its chips
-                    # beats granting them to a second job mid-flight — the
-                    # zombie's own ``with`` exit releases them if it ever
-                    # reaches a cooperative boundary
+                    # abandoned zombie still computing: don't grant its
+                    # chips to a second job mid-flight, but don't leak them
+                    # forever either (the PR 7 leak) — a reaper reclaims
+                    # the lease the moment the thread exits, or forcibly
+                    # after the lease_reap_after_s TTL
                     logger.warning(
                         "scheduler: abandoned attempt for %s still holds "
-                        "devices %s", msg_id, lease.devices)
+                        "devices %s — reap on exit or after %.0fs",
+                        msg_id, lease.devices, self.cfg.lease_reap_after_s)
+                    self._watch_zombie(msg_id, lease, attempt)
                 else:
                     lease.release()   # zombie never got a grant: deregister
             if hb is not None:
@@ -916,6 +950,29 @@ class JobScheduler:
                 t = rec.tenant
                 self._inflight_by_tenant[t] = max(
                     0, self._inflight_by_tenant.get(t, 1) - 1)
+
+    def _watch_zombie(self, msg_id: str, lease, attempt) -> None:
+        """Reclaim an abandoned attempt's chip lease (ISSUE 11 satellite —
+        the PR 7 zombie-lease leak).  A per-zombie watcher joins the stuck
+        thread: the lease is reaped the moment it exits, or forcibly after
+        ``lease_reap_after_s`` (0 = wait for the thread forever).  Release
+        is idempotent, so the zombie's own late ``with`` exit is safe."""
+        ttl = self.cfg.lease_reap_after_s
+
+        def _reap():
+            attempt.join(timeout=ttl if ttl > 0 else None)
+            forced = attempt.is_alive()
+            if forced:
+                logger.warning(
+                    "scheduler: zombie attempt for %s outlived the %.0fs "
+                    "lease TTL — force-reaping devices %s (the thread may "
+                    "still touch them until it exits)",
+                    msg_id, ttl, lease.devices)
+            self.device_pool.reap(lease,
+                                  reason="ttl" if forced else "exit")
+
+        threading.Thread(target=_reap, daemon=True,
+                         name=f"lease-reap-{msg_id}").start()
 
     # ------------------------------------------------------- cancellation
     def _deliver_cancel(self, token: CancelToken, rec: JobRecord,
@@ -1239,10 +1296,52 @@ class JobScheduler:
         shards + replica-local admission state, so peers (and ``GET
         /peers``) can approximate global quotas and shed decisions."""
         s: dict = {"owned": sorted(self._owned), "workers": self.cfg.workers,
-                   "fenced_claims": self._fenced_count}
+                   "fenced_claims": self._fenced_count,
+                   "draining": self._draining}
         if self.admission is not None:
             s["admission"] = self.admission.stats()
         return s
+
+    # --------------------------------------------------------------- drain
+    def _begin_drain(self) -> None:
+        """A drain request landed (fleet controller scale-down, or an
+        operator touching the registry sentinel): stop claiming — peers
+        adopt the shards via ``registry.active()`` — and let in-flight
+        work finish or unwind under its normal failure policy."""
+        self._draining = True
+        # victim-killed-mid-drain seam: a crash here leaves claims in
+        # running/ with fresh-then-stale heartbeats; peers fence + requeue
+        # them and complete the work exactly once
+        failpoint(FP_DRAIN_HANDOFF)
+        self._recompute_owned()
+        tracing.event("drain.begin", replica=self.replica_id,
+                      claims=self.live_claims())
+        logger.info("replica %s: drain requested — releasing shard "
+                    "ownership, %d claim(s) in flight",
+                    self.replica_id, self.live_claims())
+
+    def _drain_idle(self) -> bool:
+        """True once nothing is claimed, running, or buffered — every
+        in-flight message reached a terminal outcome, was requeued, or was
+        fenced away."""
+        with self._records_lock:
+            if self._lease_by_msg or self._live:
+                return False
+        return self._handoff.empty()
+
+    def _ack_drain(self) -> None:
+        failpoint(FP_RETIRE_ACK)
+        self.registry.ack_drain()
+        record_recovery("fleet.drain_complete")
+        self._drain_done.set()
+        tracing.event("drain.ack", replica=self.replica_id)
+        logger.info("replica %s: drain complete — acked, ready to retire",
+                    self.replica_id)
+
+    def drain_complete(self) -> bool:
+        """True once this replica drained and acked; the serve loop (and
+        the bare replica harness) exits and shuts down on this."""
+        return self._drain_done.is_set()
 
     def _takeover_scan(self) -> None:
         """One takeover pass: recompute shard ownership from the live
@@ -1251,6 +1350,8 @@ class JobScheduler:
         work in shards we don't own is never reaped."""
         failpoint(FP_TAKEOVER_SCAN)
         owned = self._recompute_owned()
+        if self._draining:
+            return                    # nothing owned; adopt no peer work
         n = self._requeue_stale_owned(self.cfg.stale_after_s)
         if n:
             logger.info("replica %s: takeover requeued %d stale claim(s)",
@@ -1275,6 +1376,18 @@ class JobScheduler:
                              gc_interval) / 4.0)
         while not self._stop.is_set():
             now = time.time()
+            # zero-loss drain (ISSUE 11): notice the request once, then ack
+            # as soon as every in-flight claim resolved.  Heartbeats keep
+            # going while draining so peers never fence live work.
+            try:
+                if not self._draining and self.registry.drain_requested():
+                    self._begin_drain()
+                if self._draining and not self._drain_done.is_set() and \
+                        self._drain_idle():
+                    self._ack_drain()
+            except OSError:
+                logger.warning("replica %s: drain check failed",
+                               self.replica_id, exc_info=True)
             if now >= next_beat:
                 try:
                     self.registry.beat(summary=self._beat_summary())

@@ -75,7 +75,8 @@ class AnnotationService:
         # single-token serialization)
         self.device_pool = DevicePool(
             resolve_pool_size(cfg, backend=self.sm_config.backend),
-            max_bypass=cfg.device_pool_max_bypass)
+            max_bypass=cfg.device_pool_max_bypass,
+            hosts=cfg.device_pool_hosts)
         self.device_pool.attach_metrics(self.metrics)
         # resource governor (ISSUE 10, service/resources.py): disk-budget
         # preflight at every governed write seam, degrade order traces →
@@ -125,6 +126,8 @@ class AnnotationService:
         self.residency = residency
         self.started_at = time.time()
         self._stop_requested = threading.Event()
+        self._shutdown_done = threading.Event()
+        self._shutdown_once = threading.Lock()
         self._phase_hist = self.metrics.histogram(
             "sm_phase_seconds", "Pipeline phase wall clock by phase name",
             ("phase",))
@@ -198,10 +201,23 @@ class AnnotationService:
         logger.info("service: up (queue=%s)", self.queue_dir / self.queue)
 
     def shutdown(self, timeout_s: float | None = None) -> bool:
-        """Drain and stop everything; safe to call more than once."""
-        if self._stop_requested.is_set():
+        """Drain and stop everything; safe to call more than once.  A
+        concurrent caller BLOCKS until the in-flight drain finishes —
+        otherwise the main thread (run_forever's finally) can exit the
+        process while the signal-drain thread is still mid-retire,
+        leaving registry/heartbeat debris behind (ISSUE 11: a retired
+        replica must leave nothing)."""
+        with self._shutdown_once:
+            if self._stop_requested.is_set():
+                first = False
+            else:
+                self._stop_requested.set()
+                first = True
+        if not first:
+            self._shutdown_done.wait(
+                timeout=(timeout_s if timeout_s is not None else
+                         self.sm_config.service.drain_timeout_s) + 10.0)
             return True
-        self._stop_requested.set()
         logger.info("service: shutdown requested — draining")
         ok = self.scheduler.shutdown(timeout_s)
         if self.api is not None:
@@ -218,6 +234,7 @@ class AnnotationService:
         if get_governor() is self.resources:
             tracing.set_file_gate(None)
             set_governor(None)
+        self._shutdown_done.set()
         return ok
 
     def install_signal_handlers(self) -> None:
@@ -240,6 +257,12 @@ class AnnotationService:
         idle_since = None
         try:
             while not self._stop_requested.is_set():
+                if self.scheduler.drain_complete():
+                    # zero-loss drain (ISSUE 11): the replica acked — every
+                    # claim resolved, nothing more will be written; exit so
+                    # the controller can count the drain done
+                    logger.info("service: drain acked — retiring")
+                    break
                 if max_terminal is not None and \
                         self.scheduler._terminal_count >= max_terminal:
                     break

@@ -210,6 +210,9 @@ class DeviceMonitor:
         }
         if pool_snap is not None:
             snap["device_pool_size"] = pool_snap["size"]
+            snap["device_pool_hosts"] = pool_snap.get("hosts", 1)
+            snap["device_pool_per_host_in_use"] = pool_snap.get(
+                "per_host_in_use")
             snap["device_pool_in_use"] = pool_snap["in_use"]
             snap["device_pool_ratio"] = round(
                 pool_snap["in_use"] / max(1, pool_snap["size"]), 4)

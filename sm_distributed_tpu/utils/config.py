@@ -206,6 +206,64 @@ class AdmissionConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Elastic replica fleet (service/fleet.py, docs/SERVICE.md "Elasticity
+    model").  A FleetController supervises replica subprocesses and makes
+    hysteresis-damped scale decisions between ``min_replicas`` and
+    ``max_replicas`` from the live signals the service already exports:
+    ``/slo`` error-budget burn, admission queue depth, and device-pool
+    occupancy.  Scale-down is a zero-loss *drain*: the victim stops
+    claiming, finishes or releases in-flight work, acks, and retires —
+    rendezvous hashing re-owns its shards and fenced leases make the
+    handoff safe by construction."""
+    enabled: bool = False                # serve --fleet (or this knob) runs
+                                         # the controller beside replica r0
+    min_replicas: int = 1                # repair floor (crash replacement
+                                         # bypasses hysteresis + cooldown)
+    max_replicas: int = 4                # scale ceiling
+    decide_interval_s: float = 5.0       # controller decision cadence
+    cooldown_s: float = 60.0             # min gap between scale events, so
+                                         # flapping traffic can't thrash
+    hysteresis_ticks: int = 2            # consecutive decide ticks a signal
+                                         # must hold before acting
+    scale_up_burn: float = 1.0           # worst /slo error-budget burn at or
+                                         # above this is scale-up pressure
+    scale_down_burn: float = 0.5         # burn must be at or below this for
+                                         # scale-down relief
+    queue_high_per_replica: float = 8.0  # pending depth / alive replicas at
+                                         # or above this is pressure
+    queue_low_per_replica: float = 1.0   # ... at or below this is relief
+    occupancy_high: float = 0.95         # pool occupancy at or above this is
+                                         # pressure (0 disables the signal)
+    spawn_timeout_s: float = 30.0        # a spawned replica must register a
+                                         # heartbeat within this or count as
+                                         # a failed spawn
+    drain_timeout_s: float = 120.0       # drain ack + process exit deadline
+                                         # before the victim is force-killed
+
+    def __post_init__(self):
+        if self.min_replicas <= 0 or self.max_replicas < self.min_replicas:
+            raise ValueError("fleet: need 1 <= min_replicas <= max_replicas")
+        if self.decide_interval_s <= 0 or self.cooldown_s < 0 or \
+                self.hysteresis_ticks < 1:
+            raise ValueError("fleet: decide_interval_s must be positive, "
+                             "cooldown_s >= 0, hysteresis_ticks >= 1")
+        if self.scale_up_burn <= 0 or self.scale_down_burn < 0 or \
+                self.scale_down_burn > self.scale_up_burn:
+            raise ValueError("fleet: need 0 <= scale_down_burn <= "
+                             "scale_up_burn")
+        if self.queue_high_per_replica <= 0 or \
+                self.queue_low_per_replica < 0 or \
+                self.queue_low_per_replica > self.queue_high_per_replica:
+            raise ValueError("fleet: need 0 <= queue_low_per_replica <= "
+                             "queue_high_per_replica")
+        if not 0.0 <= self.occupancy_high <= 1.0:
+            raise ValueError("fleet: occupancy_high must be in [0, 1]")
+        if self.spawn_timeout_s <= 0 or self.drain_timeout_s <= 0:
+            raise ValueError("fleet: spawn/drain timeouts must be positive")
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """Annotation-service knobs (scheduler + failure policy + admin API) —
     the serving-side analog of the reference's rabbitmq/daemon settings.
@@ -255,6 +313,19 @@ class ServiceConfig:
                                          # queue (anti-starvation for
                                          # sub-mesh jobs under small-job
                                          # traffic)
+    device_pool_hosts: int = 1           # host dimension of the pool (a
+                                         # jax.distributed-style host×chip
+                                         # topology, simulated on CPU): the
+                                         # pool's chips split into this many
+                                         # equal failure domains; 1-host
+                                         # leases are preferred, a sub-mesh
+                                         # lease may span hosts and reports
+                                         # them (DeviceLease.hosts)
+    lease_reap_after_s: float = 300.0    # an abandoned (zombie) attempt's
+                                         # device lease is reclaimed when
+                                         # its thread exits, or forcibly
+                                         # after this TTL; 0 = wait for the
+                                         # thread forever
     # --- multi-replica scheduling (service/leases.py, ISSUE 8) ---
     replica_id: str = "r0"               # this scheduler process's identity
                                          # (serve --replica-id); leases and
@@ -277,6 +348,7 @@ class ServiceConfig:
                                          # the breaker is open (reduced from
                                          # parallel.formula_batch)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     def __post_init__(self):
         if self.workers <= 0 or self.max_attempts <= 0:
@@ -294,6 +366,9 @@ class ServiceConfig:
             raise ValueError("service: device-pool knobs out of range "
                              "(device_pool_size >= 0, devices_per_job >= 1, "
                              "device_pool_max_bypass >= 0)")
+        if self.device_pool_hosts <= 0 or self.lease_reap_after_s < 0:
+            raise ValueError("service: device_pool_hosts must be >= 1 and "
+                             "lease_reap_after_s >= 0")
         if not self.replica_id or self.replicas <= 0 or self.spool_shards <= 0:
             raise ValueError("service: replica_id must be non-empty and "
                              "replicas/spool_shards positive")
@@ -501,4 +576,5 @@ _DATACLASS_FIELDS = {
     ("SMConfig", "resources"): ResourcesConfig,
     ("SMConfig", "logs"): LogsConfig,
     ("ServiceConfig", "admission"): AdmissionConfig,
+    ("ServiceConfig", "fleet"): FleetConfig,
 }

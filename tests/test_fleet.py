@@ -1,0 +1,368 @@
+"""Elastic replica fleet (ISSUE 11): scale-decision logic, drain-vs-crash
+registry transitions, the scheduler's zero-loss drain, the host-aware
+device pool, and the zombie-lease reaper.
+
+The decision tests drive the PURE ``decide`` function with synthetic
+signal snapshots — no subprocesses, no sleeping; boundaries (hysteresis
+tick counts, cooldown instants, burn thresholds, min/max clamps) are
+pinned exactly.  The subprocess-level 1→4→2 wave is proven by
+``scripts/load_sweep.py --elastic`` (check_tier1's elastic smoke gate).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from sm_distributed_tpu.engine.daemon import QueuePublisher
+from sm_distributed_tpu.service.device_pool import DevicePool
+from sm_distributed_tpu.service.fleet import (
+    FleetSignals,
+    FleetState,
+    decide,
+    spool_signals,
+)
+from sm_distributed_tpu.service.leases import ReplicaRegistry
+from sm_distributed_tpu.service.metrics import MetricsRegistry
+from sm_distributed_tpu.service.scheduler import JobScheduler
+from sm_distributed_tpu.utils.config import FleetConfig, ServiceConfig
+
+CFG = FleetConfig(min_replicas=1, max_replicas=4, cooldown_s=60.0,
+                  hysteresis_ticks=2, scale_up_burn=1.0,
+                  scale_down_burn=0.5, queue_high_per_replica=8.0,
+                  queue_low_per_replica=1.0, occupancy_high=0.95)
+
+
+def _sig(**kw):
+    base = dict(queue_depth=0, alive=2)
+    base.update(kw)
+    return FleetSignals(**base)
+
+
+# ------------------------------------------------------------ decision rule
+def test_repair_below_min_bypasses_hysteresis_and_cooldown():
+    # one tick, cooldown NOT elapsed — repair still fires
+    state = FleetState(last_scale_at=1000.0)
+    delta, _ = decide(CFG, state, _sig(alive=0), now=1000.1)
+    assert delta == 1
+
+
+def test_above_max_drains_immediately():
+    state = FleetState(last_scale_at=1000.0)
+    delta, _ = decide(CFG, state, _sig(alive=5), now=1000.1)
+    assert delta == -1
+
+
+def test_hysteresis_boundary_exact_tick_count():
+    # pressure must hold hysteresis_ticks=2 CONSECUTIVE ticks
+    state = FleetState()
+    delta, state = decide(CFG, state, _sig(queue_depth=100), now=100.0)
+    assert delta == 0 and state.high_ticks == 1
+    delta, state = decide(CFG, state, _sig(queue_depth=100), now=101.0)
+    assert delta == 1 and state.high_ticks == 0     # act consumes the ticks
+
+
+def test_hysteresis_resets_on_a_calm_tick():
+    state = FleetState()
+    _d, state = decide(CFG, state, _sig(queue_depth=100), now=100.0)
+    _d, state = decide(CFG, state, _sig(queue_depth=4), now=101.0)
+    assert state.high_ticks == 0
+    delta, state = decide(CFG, state, _sig(queue_depth=100), now=102.0)
+    assert delta == 0 and state.high_ticks == 1     # counting restarts
+
+
+def test_cooldown_blocks_then_releases_scaling():
+    state = FleetState(last_scale_at=1000.0, high_ticks=5)
+    # one tick short of the cooldown: pressure held, still no action
+    delta, state = decide(CFG, state, _sig(queue_depth=100),
+                          now=1000.0 + CFG.cooldown_s - 0.01)
+    assert delta == 0
+    delta, state = decide(CFG, state, _sig(queue_depth=100),
+                          now=1000.0 + CFG.cooldown_s)
+    assert delta == 1
+
+
+def test_burn_threshold_boundaries():
+    # burn at the scale_up threshold is pressure; just below is not
+    st = FleetState(high_ticks=5)
+    delta, _ = decide(CFG, st, _sig(burn=CFG.scale_up_burn), now=1e6)
+    assert delta == 1
+    delta, _ = decide(CFG, st, _sig(burn=CFG.scale_up_burn - 0.01), now=1e6)
+    assert delta == 0
+    # relief requires burn <= scale_down_burn even with an empty queue
+    st = FleetState(low_ticks=5)
+    delta, _ = decide(CFG, st, _sig(burn=CFG.scale_down_burn + 0.01), now=1e6)
+    assert delta == 0
+    delta, _ = decide(CFG, st, _sig(burn=CFG.scale_down_burn), now=1e6)
+    assert delta == -1
+
+
+def test_occupancy_pressure_and_disable():
+    st = FleetState(high_ticks=5)
+    delta, _ = decide(CFG, st, _sig(occupancy=0.96), now=1e6)
+    assert delta == 1
+    off = FleetConfig(min_replicas=1, max_replicas=4, hysteresis_ticks=1,
+                      occupancy_high=0.0)          # 0 disables the signal
+    # mid-range queue: neither pressure nor relief — a saturated pool must
+    # NOT scale the fleet up when the signal is disabled
+    delta, _ = decide(off, FleetState(high_ticks=5),
+                      _sig(queue_depth=4, occupancy=1.0), now=1e6)
+    assert delta == 0
+
+
+def test_min_max_clamps_suppress_voluntary_moves():
+    # at the ceiling, sustained pressure does nothing
+    st = FleetState(high_ticks=50)
+    delta, _ = decide(CFG, st, _sig(queue_depth=10_000, alive=4), now=1e6)
+    assert delta == 0
+    # at the floor, sustained relief does nothing
+    st = FleetState(low_ticks=50)
+    delta, _ = decide(CFG, st, _sig(queue_depth=0, alive=1), now=1e6)
+    assert delta == 0
+
+
+def test_queue_per_replica_scaling_is_relative_to_fleet_size():
+    # same depth: pressure at 1 replica, calm at 4
+    st = FleetState(high_ticks=5)
+    delta, _ = decide(CFG, st, _sig(queue_depth=10, alive=1), now=1e6)
+    assert delta == 1
+    delta, _ = decide(CFG, st, _sig(queue_depth=10, alive=4), now=1e6)
+    assert delta == 0
+
+
+# ------------------------------------------- drain-vs-crash registry states
+def test_drain_request_excludes_from_active_but_not_alive(tmp_path):
+    r1 = ReplicaRegistry(tmp_path, "r1", stale_after_s=5.0)
+    r1.register()
+    r2 = ReplicaRegistry(tmp_path, "r2", stale_after_s=5.0)
+    r2.register()
+    assert r1.active() == {"r1", "r2"}
+    r1.request_drain("r2", by="test")
+    # draining: still ALIVE (heartbeats fresh — claims must not be fenced)
+    # but out of the ownership set, and flagged on the peers view
+    assert r1.alive() == {"r1", "r2"}
+    assert r1.active() == {"r1"}
+    peers = {p["replica_id"]: p for p in r1.peers()}
+    assert peers["r2"]["draining"] and not peers["r1"]["draining"]
+
+
+def test_drain_ack_and_clear_lifecycle(tmp_path):
+    reg = ReplicaRegistry(tmp_path, "r0", stale_after_s=5.0)
+    reg.register()
+    reg.request_drain("r0", by="controller")
+    assert reg.drain_requested() and not reg.drain_acked("r0")
+    reg.ack_drain()
+    assert reg.drain_acked("r0")
+    reg.retire()                       # drained replica leaves NO heartbeat
+    assert not (tmp_path / "replicas" / "r0.json").exists()
+    reg.clear_drain("r0")              # controller cleans the sentinel
+    assert not reg.drain_requested("r0") and reg.draining_ids() == set()
+
+
+def test_register_clears_stale_drain_from_prior_incarnation(tmp_path):
+    reg = ReplicaRegistry(tmp_path, "r0", stale_after_s=5.0)
+    reg.register()
+    reg.request_drain("r0")
+    # the process "crashes" and restarts: the new incarnation must not
+    # honor the dead one's drain request (it would refuse all work)
+    reg2 = ReplicaRegistry(tmp_path, "r0", stale_after_s=5.0)
+    reg2.register()
+    assert not reg2.drain_requested()
+
+
+def test_crashed_replica_is_stale_not_draining(tmp_path):
+    reg = ReplicaRegistry(tmp_path, "dead", stale_after_s=0.2)
+    reg.register()
+    obs = ReplicaRegistry(tmp_path, "obs", stale_after_s=0.2)
+    obs.register()
+    time.sleep(0.3)
+    obs.beat()
+    peers = {p["replica_id"]: p for p in obs.peers()}
+    # crash evidence: heartbeat file PRESENT but stale, no drain sentinel
+    assert (tmp_path / "replicas" / "dead.json").exists()
+    assert not peers["dead"]["alive"] and not peers["dead"]["draining"]
+    assert "dead" not in obs.active()
+
+
+# --------------------------------------------------- scheduler drain (live)
+def _sched_cfg(**over):
+    kw = dict(workers=2, poll_interval_s=0.02, heartbeat_interval_s=0.1,
+              stale_after_s=1.0, replica_heartbeat_interval_s=0.05,
+              replica_stale_after_s=1.0, takeover_interval_s=0.1,
+              backoff_base_s=0.05, backoff_max_s=0.1, backoff_jitter=0.0)
+    kw.update(over)
+    return ServiceConfig(**kw)
+
+
+def test_scheduler_drains_in_flight_work_then_acks(tmp_path):
+    done = []
+
+    def cb(msg):
+        time.sleep(0.2)
+        done.append(msg["ds_id"])
+
+    sched = JobScheduler(tmp_path, cb, config=_sched_cfg())
+    pub = QueuePublisher(tmp_path)
+    for i in range(3):
+        pub.publish({"ds_id": f"d{i}", "msg_id": f"d{i}", "input_path": "x"})
+    sched.start()
+    try:
+        deadline = time.time() + 10.0
+        while time.time() < deadline and sched.live_claims() == 0:
+            time.sleep(0.01)
+        sched.registry.request_drain(sched.replica_id, by="test")
+        deadline = time.time() + 15.0
+        while time.time() < deadline and not sched.drain_complete():
+            time.sleep(0.02)
+        assert sched.drain_complete()
+        assert sched.registry.drain_acked(sched.replica_id)
+        # zero loss: every claimed message finished; nothing stuck
+        root = tmp_path / "sm_annotate"
+        assert not list(root.glob("running/*.json"))
+        assert not list(root.glob("pending/*.json"))
+        assert len(list(root.glob("done/*.json"))) == 3
+        assert sched.peers()["draining"] is True
+        assert sched.peers()["owned"] == []        # ownership released
+    finally:
+        sched.shutdown()
+    # drained + retired: no heartbeat file left behind
+    assert not (tmp_path / "sm_annotate" / "replicas" / "r0.json").exists()
+
+
+def test_draining_scheduler_claims_nothing_new(tmp_path):
+    sched = JobScheduler(tmp_path, lambda m: None, config=_sched_cfg())
+    sched.start()
+    try:
+        sched.registry.request_drain(sched.replica_id, by="test")
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not sched.drain_complete():
+            time.sleep(0.02)
+        assert sched.drain_complete()
+        # a message published AFTER the drain must stay unclaimed by this
+        # replica (peers — none here — own every shard now)
+        QueuePublisher(tmp_path).publish(
+            {"ds_id": "late", "msg_id": "late", "input_path": "x"})
+        time.sleep(0.3)
+        assert list((tmp_path / "sm_annotate" / "pending").glob("*.json"))
+        assert sched.live_claims() == 0
+    finally:
+        sched.shutdown()
+
+
+def test_spool_signals_counts_queue_and_membership(tmp_path):
+    reg = ReplicaRegistry(tmp_path / "sm_annotate", "r1", stale_after_s=5.0)
+    reg.register()
+    pub = QueuePublisher(tmp_path)
+    for i in range(4):
+        pub.publish({"ds_id": f"q{i}", "msg_id": f"q{i}", "input_path": "x"})
+    sig = spool_signals(tmp_path / "sm_annotate", reg)()
+    assert sig.queue_depth == 4 and sig.alive == 1
+    reg.request_drain("r1")
+    assert spool_signals(tmp_path / "sm_annotate", reg)().alive == 0
+
+
+# --------------------------------------------------------- host-aware pool
+def test_pool_host_topology_and_single_host_preference():
+    p = DevicePool(8, hosts=2)
+    assert p.chips_per_host == 4 and p.host_of(3) == 0 and p.host_of(4) == 1
+    a = p.lease(2, msg_id="a")
+    a.acquire()
+    assert a.hosts == (0,)
+    # chips 2,3 are free on host 0 but a 4-chip lease cannot fit in either
+    # host's remainder — host 1 is fully free, so it lands there whole
+    b = p.lease(4, msg_id="b")
+    b.acquire()
+    assert b.devices == (4, 5, 6, 7) and b.hosts == (1,)
+    snap = p.snapshot()
+    assert snap["hosts"] == 2 and snap["per_host_in_use"] == [2, 4]
+    a.release()
+    b.release()
+    # wider than a host: legitimately spans both and reports it
+    wide = p.lease(6, msg_id="w")
+    wide.acquire()
+    assert wide.hosts == (0, 1)
+    wide.release()
+
+
+def test_pool_non_dividing_hosts_degrades_to_single_host():
+    p = DevicePool(8, hosts=3)
+    assert p.hosts == 1 and p.chips_per_host == 8
+
+
+def test_pool_reap_is_idempotent_and_counted():
+    p = DevicePool(2)
+    m = MetricsRegistry()
+    p.attach_metrics(m)
+    lease = p.lease(1, msg_id="z")
+    lease.acquire()
+    p.reap(lease, reason="ttl")
+    assert p.in_use_count() == 0 and p.leases_reaped_total == 1
+    p.reap(lease, reason="ttl")                    # second reap: no-op
+    assert p.leases_reaped_total == 1
+    assert 'sm_device_pool_leases_reaped_total{reason="ttl"} 1' in m.expose()
+
+
+def test_zombie_lease_reaped_after_ttl(tmp_path):
+    """The PR 7 leak, end to end: an attempt that ignores its cancel past
+    the grace period is abandoned WITH its chips — the reaper must return
+    them to the pool within lease_reap_after_s."""
+    release_evt = threading.Event()
+
+    def stubborn(msg, ctx):
+        with ctx.device_token:
+            release_evt.wait(timeout=10.0)         # ignores cancel entirely
+
+    m = MetricsRegistry()
+    sched = JobScheduler(
+        tmp_path, stubborn,
+        config=_sched_cfg(workers=1, job_timeout_s=0.2, cancel_grace_s=0.1,
+                          lease_reap_after_s=0.3, max_attempts=1),
+        metrics=m)
+    QueuePublisher(tmp_path).publish(
+        {"ds_id": "z", "msg_id": "z", "input_path": "x"})
+    sched.start()
+    try:
+        deadline = time.time() + 15.0
+        while time.time() < deadline and \
+                sched.device_pool.leases_reaped_total == 0:
+            time.sleep(0.02)
+        assert sched.device_pool.leases_reaped_total == 1
+        assert sched.device_pool.in_use_count() == 0
+        text = m.expose()
+        assert "sm_device_pool_leases_reaped_total" in text
+    finally:
+        release_evt.set()
+        sched.shutdown()
+
+
+def test_fleet_metrics_families_exposed(tmp_path):
+    from sm_distributed_tpu.service.fleet import FleetController
+
+    m = MetricsRegistry()
+    fc = FleetController(
+        tmp_path, FleetConfig(min_replicas=1, max_replicas=2),
+        ServiceConfig(), spawn=lambda rid: (_ for _ in ()).throw(
+            OSError("no spawns in this test")),
+        metrics=m)
+    st = fc.status()
+    assert st["alive"] == 0 and st["min"] == 1
+    text = m.expose()
+    for fam in ("sm_fleet_replicas", "sm_fleet_target_replicas",
+                "sm_fleet_scale_events_total", "sm_fleet_drains_total",
+                "sm_fleet_crashes_total"):
+        assert fam in text, fam
+
+
+def test_write_child_config_disables_nested_fleet(tmp_path):
+    from sm_distributed_tpu.service.fleet import write_child_config
+    from sm_distributed_tpu.utils.config import SMConfig
+
+    sm = SMConfig.from_dict({"service": {"fleet": {"enabled": True}}})
+    p = write_child_config(sm, tmp_path)
+    child = json.loads(p.read_text())
+    assert child["service"]["fleet"]["enabled"] is False
+    # and it round-trips through the strict loader
+    assert SMConfig.from_dict(child).service.fleet.enabled is False
