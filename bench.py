@@ -565,6 +565,13 @@ def main() -> None:
     from sm_distributed_tpu.utils.logger import init_logger
 
     init_logger()
+    # compile-retrace attribution (ISSUE 12, analysis/retrace.py): the
+    # bench pins how many XLA compiles it paid and how many distinct
+    # signatures they covered — a widening signature count on the same
+    # workload is the unbounded-retrace regression the census gates
+    from sm_distributed_tpu.analysis import retrace
+
+    retrace.enable()
     cache_dir = Path(__file__).parent / ".cache"
     n_procs = max(1, args.floor_procs or os.cpu_count() or 1)
 
@@ -617,6 +624,10 @@ def main() -> None:
         out["multichip"] = measure_multichip(
             configs[-1], preps[-1], cache_dir, args.devices,
             args.mesh_formulas)
+    compile_snap = retrace.snapshot()
+    out["compile_events"] = compile_snap["events_total"]
+    out["compile_signatures"] = compile_snap["signatures_total"]
+    out["compile_sites"] = len(compile_snap["sites"])
     out["trace_path"] = write_bench_trace(cache_dir, configs, out)
     print(json.dumps(out))
 

@@ -59,6 +59,34 @@ if ! env JAX_PLATFORMS=cpu python scripts/smlint.py --self-check; then
     exit 1
 fi
 
+# analysis drift sentinel (ISSUE 12): the smlint --json totals (per-rule
+# finding counts + the static compile-surface census) are band-checked
+# against the committed ANALYSIS_r*.json history, so a quietly growing
+# suppressed count or compile surface diffs across rounds like a perf
+# regression would
+SMLINT_JSON="$(mktemp /tmp/smlint_fresh.XXXXXX.json)"
+trap 'rm -f "$LOG" "$SMLINT_JSON"' EXIT
+if ! env JAX_PLATFORMS=cpu python scripts/smlint.py --json > "$SMLINT_JSON"; then
+    echo "check_tier1: FAIL — smlint --json artifact generation failed" >&2
+    exit 1
+fi
+if ! env JAX_PLATFORMS=cpu python scripts/perf_sentinel.py \
+        --history "$REPO_ROOT/ANALYSIS_r*.json" --fresh "$SMLINT_JSON" \
+        --min-history 1; then
+    echo "check_tier1: FAIL — analysis drift sentinel tripped" >&2
+    exit 1
+fi
+
+# compile census gate (ISSUE 12): the spheroid fixture through the real
+# service on the jax backend — every XLA compilation attributed to a
+# COMPILE_SURFACE-registered call site, the signature set closed under a
+# second identical-shape job, the sharded path attributed the same way,
+# and sm_compile_* live on /metrics with a `compile` trace event
+if ! env JAX_PLATFORMS=cpu python scripts/compile_census.py; then
+    echo "check_tier1: FAIL — compile census gate failed" >&2
+    exit 1
+fi
+
 # failpoint registry gate (now DELEGATES to the smlint failpoint-registry
 # rule + the runtime scenario-table cross-check the static rule can't see)
 if ! env JAX_PLATFORMS=cpu python scripts/chaos_sweep.py --check-docs; then

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Compile census gate (ISSUE 12 tentpole; wired into scripts/check_tier1.sh).
+
+Proves the engine's OBSERVED compile surface matches the DECLARED one
+(``analysis/surface.py`` COMPILE_SURFACE registries) and is CLOSED under
+repeated same-shaped traffic, through the REAL service stack:
+
+1. the spheroid fixture runs through a real in-process service on the
+   ``jax_tpu`` backend (single device) with the retrace tracer on — every
+   XLA compilation must be attributed to a call site whose module carries
+   a ``COMPILE_SURFACE`` registration (**zero unattributed compiles**;
+   driver/test frames and ``<external>`` sites fail the gate);
+2. a SECOND identical-shape job (new dataset id, same geometry) re-runs —
+   it may recompile (fresh backend, no persistent cache) but must add
+   **zero new signatures**: the signature set is closed, which is exactly
+   the property cold-start annihilation (ROADMAP item 1) needs;
+3. a ``devices: 2`` submit on a virtual 2-chip CPU mesh exercises the
+   pjit/shard_map SHARDED path — its compiles must attribute to the
+   registered ``parallel/sharded.py`` surface the same way;
+4. ``sm_compile_events_total`` / ``sm_compile_signatures`` are live on
+   ``/metrics``, and the per-job trace carries ≥1 ``compile`` event (the
+   cold compile is visible INSIDE the job that paid for it).
+
+Exit 0 = gate passes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+# the virtual 2-chip mesh must exist BEFORE jax initializes (same dance as
+# multichip_smoke / tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=2")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from scripts.load_sweep import Harness, _msg, build_fixtures  # noqa: E402
+from sm_distributed_tpu.analysis import retrace, surface  # noqa: E402
+
+N_DEVICES = 2
+
+# site files allowed WITHOUT a COMPILE_SURFACE registration: none.  The
+# census is the proof that this list stays empty — a compile attributed to
+# scripts/, tests/, engine/, or "<external>" means a jit escaped the
+# declared surface.
+_SELF = "scripts/compile_census.py"
+
+
+def fail(msg: str) -> int:
+    print(f"compile_census: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def _unattributed(snap: dict) -> list[str]:
+    """Observed sites whose module carries no COMPILE_SURFACE entry."""
+    out = []
+    for site in snap["sites"]:
+        path = site.split(":", 1)[0]
+        if path == _SELF:
+            # the census's own harness frames never dispatch jitted code;
+            # seeing one here is itself an attribution bug
+            out.append(site)
+        elif not surface.is_registered_path(path):
+            out.append(site)
+    return out
+
+
+def _sig_set(snap: dict) -> set[tuple[str, str]]:
+    return {(site, sig) for site, ent in snap["sites"].items()
+            for sig in ent["signatures"]}
+
+
+def run(work: Path) -> int:
+    fx = build_fixtures(work)
+    h = Harness(work, "compile_census", sm_overrides={
+        "backend": "jax_tpu",
+        "service": {"device_pool_size": N_DEVICES},
+    })
+    retrace.enable()   # harness init already bound the service metrics
+    try:
+        # ---- phase 1: first job = the cold surface
+        retrace.reset()
+        status, _hd, body = h.submit(_msg(fx, "fast", "census1"))
+        if status != 202:
+            return fail(f"submit 1 returned {status}: {body}")
+        rows = h.wait_terminal([body["msg_id"]])
+        if rows[body["msg_id"]]["state"] != "done":
+            return fail(f"job 1 state {rows[body['msg_id']]['state']}: "
+                        f"{rows[body['msg_id']]['error']!r}")
+        snap1 = retrace.snapshot()
+        if snap1["events_total"] == 0:
+            return fail("no compile events observed — the tracer saw "
+                        "nothing (vacuous census)")
+        bad = _unattributed(snap1)
+        if bad:
+            return fail(
+                "unattributed compiles — call sites outside any "
+                f"COMPILE_SURFACE-registered module: {sorted(bad)}")
+
+        # ---- phase 2: identical-shape traffic adds ZERO new signatures
+        status, _hd, body2 = h.submit(_msg(fx, "fast", "census2"))
+        if status != 202:
+            return fail(f"submit 2 returned {status}: {body2}")
+        rows = h.wait_terminal([body2["msg_id"]])
+        if rows[body2["msg_id"]]["state"] != "done":
+            return fail(f"job 2 state {rows[body2['msg_id']]['state']}")
+        snap2 = retrace.snapshot()
+        new_sigs = _sig_set(snap2) - _sig_set(snap1)
+        if new_sigs:
+            return fail(
+                f"signature set NOT closed — a second identical-shape job "
+                f"minted {len(new_sigs)} new signature(s): "
+                f"{sorted(new_sigs)[:5]}")
+
+        # ---- phase 3: the sharded path attributes the same way
+        status, _hd, body3 = h.submit(
+            _msg(fx, "fast", "census3", devices=N_DEVICES))
+        if status != 202:
+            return fail(f"sharded submit returned {status}: {body3}")
+        rows = h.wait_terminal([body3["msg_id"]])
+        if rows[body3["msg_id"]]["state"] != "done":
+            return fail(f"sharded job state {rows[body3['msg_id']]['state']}:"
+                        f" {rows[body3['msg_id']]['error']!r}")
+        snap3 = retrace.snapshot()
+        bad = _unattributed(snap3)
+        if bad:
+            return fail(f"sharded path: unattributed compiles: {sorted(bad)}")
+        sharded_sites = [s for s in snap3["sites"]
+                         if s.startswith("sm_distributed_tpu/parallel/")]
+        if not sharded_sites:
+            return fail("the devices=2 job compiled nothing attributed to "
+                        "parallel/ — the sharded surface went unobserved")
+
+        # ---- phase 4: metrics + the compile trace event
+        text = h.metrics_text()
+        for name in ("sm_compile_events_total", "sm_compile_signatures"):
+            if f"\n{name}{{" not in text and not any(
+                    ln.startswith(name) for ln in text.splitlines()):
+                return fail(f"{name} missing from /metrics")
+        with urllib.request.urlopen(
+                f"{h.base}/jobs/{body['msg_id']}/trace?raw=1",
+                timeout=30.0) as r:
+            records = json.loads(r.read())["records"]
+        compiles = [rec for rec in records
+                    if rec["kind"] == "event" and rec["name"] == "compile"]
+        if not compiles:
+            return fail("job 1's trace carries no `compile` event — the "
+                        "cold compile is invisible to the job that paid it")
+
+        census = {site: {"events": ent["events"],
+                         "signatures": len(ent["signatures"])}
+                  for site, ent in snap3["sites"].items()}
+        print("compile_census: observed surface (site -> events/distinct):")
+        for site, ent in sorted(census.items()):
+            print(f"  {site}: {ent['events']} events, "
+                  f"{ent['signatures']} signature(s)")
+        print(f"compile_census: OK — {snap3['events_total']} compiles, "
+              f"{snap3['signatures_total']} distinct signatures, all "
+              f"attributed to {len(surface.registered())} registered "
+              f"surface module(s); closed under repeat traffic; "
+              f"{len(compiles)} compile event(s) on the job trace")
+    finally:
+        h.shutdown()
+    return 0
+
+
+def main() -> int:
+    import shutil
+
+    work = Path(tempfile.mkdtemp(prefix="sm_compile_census_"))
+    try:
+        return run(work)
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,9 +89,9 @@ def _norm_bench_case(prefix: str, case: dict, out: dict) -> None:
 
 
 def normalize(data: dict) -> dict[str, tuple[float, str]]:
-    """Flatten an artifact into ``{metric: (value, direction)}``.  The two
+    """Flatten an artifact into ``{metric: (value, direction)}``.  The
     artifact kinds produce disjoint namespaces (``headline.*``/``scale.*``
-    vs ``trace.*``), so comparing a trace against bench history yields
+    vs ``trace.*`` vs ``analysis.*``), so comparing mismatched kinds yields
     zero comparable metrics — exit 2, not a silent pass."""
     out: dict[str, tuple[float, str]] = {}
     if "value" in data and "metric" in data:          # bench.py line
@@ -109,6 +109,18 @@ def normalize(data: dict) -> dict[str, tuple[float, str]]:
         for k, v in (data.get("accounting") or {}).items():
             if (v := _num(v)) is not None:
                 out[f"trace.accounting.{k}"] = (v, "down")
+    elif "sm_analysis_findings_total" in data:        # smlint --json (ISSUE 12)
+        # rule-count + compile-surface drift series: rising totals are the
+        # regression direction (a growing baseline-suppressed count or a
+        # quietly widening compile surface), so all are "down" metrics
+        for rule, v in (data.get("sm_analysis_findings_total") or {}).items():
+            if (v := _num(v)) is not None:
+                out[f"analysis.findings.{rule}"] = (v, "down")
+        for key in ("sm_compile_surface_sites_total",
+                    "sm_compile_surface_entries_total",
+                    "sm_compile_surface_modules_total"):
+            if (v := _num(data.get(key))) is not None:
+                out[f"analysis.{key[len('sm_'):]}"] = (v, "down")
     return out
 
 

@@ -145,6 +145,11 @@ SMOKE = ("score_crash", "fence_race")
 def cmd_replica_serve(args) -> int:
     """One scheduler replica process: serve until the spool stays idle
     ``--idle-exit`` seconds, then drain and dump /metrics text."""
+    # lock-order detection must wrap the lock FACTORIES before the service
+    # stack builds its locks (same ordering as chaos_sweep's consume-one)
+    from sm_distributed_tpu.analysis import lockorder
+
+    lockorder.enable_from_env()
     from sm_distributed_tpu.utils.config import SMConfig
 
     sm = SMConfig.set_path(args.sm_config)
@@ -218,6 +223,10 @@ def _sub_env(spec: str | None) -> dict:
     env.pop("SM_FAILPOINTS", None)
     if spec:
         env["SM_FAILPOINTS"] = spec
+    # lock-order detection (ISSUE 12 satellite, matching chaos_sweep and
+    # load_sweep): child replicas run with the tsan-lite detector armed —
+    # a lock-order cycle anywhere in the replica stack fails the scenario
+    env.setdefault("SM_LOCK_ORDER", "raise")
     return env
 
 

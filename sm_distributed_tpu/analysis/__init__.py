@@ -11,7 +11,7 @@ its Postgres/ES bookkeeping — and multi-replica scale-out multiplies the
 cost of a miss: an unfenced write becomes a cross-replica double-commit, a
 lock-order cycle a fleet-wide deadlock.
 
-Two halves:
+Four parts:
 
 - ``core`` + ``rules`` — a stdlib-``ast`` lint framework (rule registry,
   per-rule severity, committed suppression baseline, per-rule firing
@@ -21,6 +21,14 @@ Two halves:
   acquisition-order graph across scheduler / device-pool / admission /
   metrics / telemetry threads and reports cycles, wired into the chaos and
   load sweeps.
+- ``surface`` — the declarative ``COMPILE_SURFACE`` registry (ISSUE 12):
+  every module that jits/``shard_map``s declares each call site's statics
+  and shape-bucket policy; the ``jit-compile-surface`` rule cross-checks
+  the declarations against the AST.
+- ``retrace`` — the runtime half: a ``jax.monitoring`` hook attributing
+  every XLA compilation to its call site + abstract signature
+  (``sm_compile_*`` metrics, ``compile`` trace events), proven closed by
+  ``scripts/compile_census.py``.
 """
 
 from .core import (  # noqa: F401

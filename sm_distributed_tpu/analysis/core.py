@@ -30,6 +30,10 @@ from pathlib import Path
 SEVERITIES = ("error", "warning")
 
 _IGNORE_RE = re.compile(r"#\s*smlint:\s*ignore\[([a-z0-9_,\- ]+)\]")
+# host-sync annotation (ISSUE 12): `# smlint: host-sync-ok[reason]` marks a
+# deliberate device->host synchronization in a hot scoring module; the
+# REASON is mandatory — the annotation is an argument, not a mute button
+_HOST_SYNC_RE = re.compile(r"#\s*smlint:\s*host-sync-ok\[([^\]]*)\]")
 
 
 # ------------------------------------------------------------------ findings
@@ -115,6 +119,16 @@ class Module:
             if m:
                 out |= {r.strip() for r in m.group(1).split(",") if r.strip()}
         return out
+
+    def host_sync_reason(self, lineno: int) -> str | None:
+        """The ``# smlint: host-sync-ok[reason]`` annotation on the line or
+        the line above — None when unannotated, "" when the reason is
+        empty (the host-sync rule treats that as a violation too)."""
+        for ln in (lineno, lineno - 1):
+            m = _HOST_SYNC_RE.search(self.line_text(ln))
+            if m:
+                return m.group(1).strip()
+        return None
 
 
 class Project:

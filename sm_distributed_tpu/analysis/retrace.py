@@ -1,0 +1,222 @@
+"""Runtime retrace tracer: attribute every XLA compilation (ISSUE 12).
+
+The static ``jit-compile-surface`` rule proves call sites DECLARE a
+bounded compile surface; this module proves the surface observed at
+runtime matches.  ``enable()`` registers a ``jax.monitoring`` listener for
+the backend-compile duration event — fired synchronously inside every
+compile-cache miss — and, per compile:
+
+- walks the Python stack to the innermost frame inside this repo (the
+  **call site** that dispatched the jitted callable — ``_dispatch``,
+  ``warmup``, a test body, ...);
+- pulls the **abstract signature** from the in-flight pjit frame
+  (``_pjit_call_impl_python`` carries the closed jaxpr and executable
+  name as locals; absent — e.g. an AOT ``.compile()`` path — the
+  signature degrades to ``<opaque>`` rather than losing the event);
+- records ``(site, signature)`` into a process-global census,
+  increments ``sm_compile_events_total{site=}``, updates the
+  ``sm_compile_signatures{site=}`` distinct-signature gauge, and emits a
+  ``compile`` trace event onto the ambient job trace (so a cold-start
+  compile shows up INSIDE the job that paid for it).
+
+The listener cannot be unregistered in this jax version, so ``enable()``
+registers exactly once per process and ``disable()`` just de-activates;
+both are idempotent.  A listener fault must never fail a compile: the
+handler catches everything and logs once per process.
+
+``scripts/compile_census.py`` drives a real service with this tracer on
+and asserts the observed surface is attributed (every site's module has a
+``COMPILE_SURFACE`` registration) and CLOSED (a second same-shaped job
+adds zero new signatures).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from pathlib import Path
+
+from ..utils import tracing
+from ..utils.logger import logger
+
+# the jax monitoring event fired once per backend compile (cache miss);
+# trace-time events are ignored — retraces that HIT the executable cache
+# are cheap, the compile is what cold-start pays for
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SELF = Path(__file__).resolve()
+
+# per-site cap on STORED signature strings (the distinct count keeps
+# counting past it; the census only needs the set to prove closure, and an
+# unbounded-retrace bug is exactly when storage would explode)
+MAX_STORED_SIGNATURES = 128
+
+
+class _Census:
+    """Process-global compile census (smlint guarded-by)."""
+
+    _GUARDED_BY = {"_sites": "_lock", "_events_total": "_lock",
+                   "_overflow": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sites: dict[str, dict] = {}   # site -> {signatures:set, events:int}
+        self._events_total = 0
+        self._overflow = 0                  # signatures dropped past the cap
+
+    def record(self, site: str, signature: str) -> tuple[bool, int]:
+        """Returns (is_new_signature, distinct_count_for_site)."""
+        with self._lock:
+            ent = self._sites.setdefault(
+                site, {"signatures": set(), "events": 0})
+            ent["events"] += 1
+            self._events_total += 1
+            new = signature not in ent["signatures"]
+            if new:
+                if len(ent["signatures"]) >= MAX_STORED_SIGNATURES:
+                    self._overflow += 1
+                else:
+                    ent["signatures"].add(signature)
+            return new, len(ent["signatures"])
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "events_total": self._events_total,
+                "signatures_total": sum(
+                    len(e["signatures"]) for e in self._sites.values()),
+                "overflow": self._overflow,
+                "sites": {
+                    s: {"events": e["events"],
+                        "signatures": sorted(e["signatures"])}
+                    for s, e in sorted(self._sites.items())
+                },
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sites.clear()
+            self._events_total = 0
+            self._overflow = 0
+
+
+_census = _Census()
+_state_lock = threading.Lock()
+_active = False
+_registered = False
+_metrics = None
+_warned = False
+
+
+def _site_of_frame(frame) -> str | None:
+    """``relpath:function`` when ``frame`` is repo code, else None."""
+    try:
+        path = Path(frame.f_code.co_filename).resolve()
+    except OSError:
+        return None
+    if path == _SELF or "site-packages" in path.parts:
+        return None
+    try:
+        rel = path.relative_to(_REPO_ROOT)
+    except ValueError:
+        return None
+    return f"{rel.as_posix()}:{frame.f_code.co_name}"
+
+
+def _attribute() -> tuple[str, str, str]:
+    """(site, executable name, abstract signature) for the in-flight
+    compile, from the listener's own stack."""
+    site, fn_name, sig = "<external>", "", "<opaque>"
+    f = sys._getframe(2)            # skip _attribute + the listener
+    while f is not None:
+        if f.f_code.co_name == "_pjit_call_impl_python":
+            loc = f.f_locals
+            name = loc.get("name")
+            if isinstance(name, str):
+                fn_name = name
+            jaxpr = loc.get("jaxpr")
+            avals = getattr(jaxpr, "in_avals", None)
+            if avals is not None:
+                sig = "(" + ", ".join(str(a) for a in avals) + ")"
+        if site == "<external>":
+            s = _site_of_frame(f)
+            if s is not None:
+                site = s
+        f = f.f_back
+    return site, fn_name, sig
+
+
+def _on_event_duration(name: str, duration: float, **_kw) -> None:
+    global _warned
+    if name != COMPILE_EVENT or not _active:
+        return
+    try:
+        site, fn_name, sig = _attribute()
+        signature = f"{fn_name}{sig}" if fn_name else sig
+        new, distinct = _census.record(site, signature)
+        m = _metrics
+        if m is not None:
+            m.counter(
+                "sm_compile_events_total",
+                "XLA backend compilations (compile-cache misses) by "
+                "attributed call site", ("site",)).labels(site=site).inc()
+            m.gauge(
+                "sm_compile_signatures",
+                "Distinct abstract signatures compiled, by attributed "
+                "call site", ("site",)).labels(site=site).set(distinct)
+        tracing.event("compile", site=site, fn=fn_name,
+                      signature=sig[:500], dur_s=round(float(duration), 4),
+                      new_signature=bool(new))
+    except Exception:
+        # a tracer fault must never fail the compile it observes
+        if not _warned:
+            _warned = True
+            logger.warning("retrace tracer: attribution failed (disabled "
+                           "for this event only)", exc_info=True)
+
+
+def enable(metrics=None) -> None:
+    """Start attributing compiles.  Idempotent; the jax listener is
+    registered once per process (this jax version has no unregister), so
+    repeated enable/disable cycles only flip the active flag.  ``metrics``
+    (a service MetricsRegistry) rebinds the ``sm_compile_*`` export —
+    the latest caller wins, matching the oom/breaker attach pattern."""
+    global _active, _registered, _metrics
+    with _state_lock:
+        if metrics is not None:
+            _metrics = metrics
+        if not _registered:
+            try:
+                from jax import monitoring
+            except ImportError:
+                logger.warning("retrace tracer: jax.monitoring unavailable; "
+                               "compile attribution disabled")
+                return
+            monitoring.register_event_duration_secs_listener(
+                _on_event_duration)
+            _registered = True
+        _active = True
+
+
+def disable() -> dict:
+    """Stop recording; returns the final census snapshot."""
+    global _active
+    with _state_lock:
+        _active = False
+    return _census.snapshot()
+
+
+def enabled() -> bool:
+    return _active
+
+
+def snapshot() -> dict:
+    """Census contents: ``{events_total, signatures_total, overflow,
+    sites: {site: {events, signatures}}}``."""
+    return _census.snapshot()
+
+
+def reset() -> None:
+    """Forget recorded compiles (tests / census phases)."""
+    _census.reset()

@@ -13,6 +13,9 @@ Rules:
 - ``guarded-by``        — declared shared attrs mutated only under their lock
 - ``broad-except``      — no silent ``except Exception`` swallows
 - ``atomic-write``      — spool/lease/registry writes use unique-tmp + os.replace
+- ``jit-compile-surface``— every jit/pjit/shard_map site declared in COMPILE_SURFACE
+- ``retrace-hazard``    — raw shapes/lengths can't flow into static args unbucketed
+- ``host-sync``         — device->host syncs in hot scoring modules are annotated
 """
 
 from __future__ import annotations
@@ -733,7 +736,446 @@ def atomic_write(project: Project):
                         f"leaks orphan tmps")
 
 
-# ========================================================== 7. broad-except
+# ==================================================== 7. jit-compile-surface
+# The cold-start invariant (ROADMAP item 1): every jax.jit / pjit /
+# shard_map call site must be covered by a module-level COMPILE_SURFACE
+# registry (analysis/surface.py) naming its shape-bucket policy, and must
+# declare its statics (static_argnames/static_argnums or donation) or be
+# registered as statics=none / statics=closure(...).  The runtime half is
+# the retrace tracer + scripts/compile_census.py.
+_JIT_CALLEES = ("jit", "pjit")
+_STATIC_KWARGS = ("static_argnames", "static_argnums",
+                  "donate_argnums", "donate_argnames")
+_POLICY_TOKENS = ("statics=", "buckets=")     # analysis/surface.POLICY_TOKENS
+
+_JCS_FIXTURE_FAIL = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax\n"
+        "from functools import partial\n"
+        "def score(x, *, b):\n"
+        "    return x\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._fn = jax.jit(partial(score, b=1))\n"
+    ),
+}
+_JCS_FIXTURE_PASS = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax\n"
+        "from functools import partial\n"
+        "from ..analysis.surface import compile_surface\n"
+        "COMPILE_SURFACE = compile_surface(__name__, {\n"
+        "    'score': 'statics=b; buckets=b padded to formula_batch',\n"
+        "    'plain': 'statics=none; buckets=single static shape',\n"
+        "})\n"
+        "def score(x, *, b):\n"
+        "    return x\n"
+        "def plain(x):\n"
+        "    return x\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self._fn = jax.jit(partial(score, b=1),\n"
+        "                           static_argnames=('b',))\n"
+        "        self._fp = jax.jit(plain)\n"
+    ),
+}
+
+
+def _surface_decl(mod) -> tuple[dict[str, tuple[str, int]] | None, int]:
+    """The module's ``COMPILE_SURFACE = compile_surface(_, {...})``
+    declaration: ({site: (policy, lineno)}, decl lineno), or (None, 0)."""
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1 and
+                isinstance(node.targets[0], ast.Name) and
+                node.targets[0].id == "COMPILE_SURFACE"):
+            continue
+        if not (isinstance(node.value, ast.Call) and
+                _call_name(node.value) == "compile_surface" and
+                len(node.value.args) >= 2 and
+                isinstance(node.value.args[1], ast.Dict)):
+            return {}, node.lineno    # declared but not the literal grammar
+        out = {}
+        for k, v in zip(node.value.args[1].keys,
+                        node.value.args[1].values):
+            ks, vs = _const_str(k), _const_str(v)
+            if ks is not None:
+                out[ks] = (vs or "", getattr(k, "lineno", node.lineno))
+        return out, node.lineno
+    return None, 0
+
+
+def _jit_sites(mod):
+    """Yield ``(call node, site name, static names | None, kind)`` for
+    every jit/pjit/shard_map call site in ``mod``.  ``static names`` is
+    the literal static_argnames tuple when given, () when a static/donate
+    kwarg exists but is not a literal name tuple, None when the call
+    declares no statics at all.  ``kind``: "jit" or "shard_map"."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _call_name(node)
+        kws = node.keywords
+        kind = None
+        if callee in _JIT_CALLEES:
+            kind = "jit"
+        elif callee == "shard_map":
+            fn = mod.enclosing_function(node)
+            if fn is not None and fn.name == "shard_map":
+                continue              # the version-compat shim itself
+            kind = "shard_map"
+        elif callee == "partial" and node.args and \
+                _attr_chain(node.args[0]).split(".")[-1] in _JIT_CALLEES:
+            kind = "jit"              # @partial(jax.jit, static_argnames=...)
+        if kind is None:
+            continue
+        statics: tuple | None = None
+        for kw in kws:
+            if kw.arg in _STATIC_KWARGS:
+                names = []
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    names = [s for s in map(_const_str, kw.value.elts)
+                             if s is not None]
+                statics = tuple(sorted(set(list(statics or ()) + names)))
+        if kind == "shard_map" and statics is None and any(
+                kw.arg in ("in_specs", "out_specs") for kw in kws):
+            statics = ()              # specs are the shard_map declaration
+        yield node, _jit_site_name(mod, node), statics, kind
+
+
+def _jit_site_name(mod, node: ast.Call) -> str:
+    """Stable registry key for one jit site: the wrapped function's name
+    when resolvable (decorated def, ``jax.jit(f)``, ``jax.jit(partial(f,
+    ...))``, ``jax.jit(shard_map(f, ...))``), else the assignment target
+    (``self._fn = jax.jit(...)`` -> ``_fn``), else the enclosing
+    qualname."""
+    parent = mod.parents.get(node)
+    # decorator (plain or partial-form): key on the decorated function
+    if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+            node in parent.decorator_list:
+        return parent.name
+    wrapped = node.args[0] if node.args else None
+    for _ in range(3):                # unwrap partial(...)/shard_map(...)
+        if isinstance(wrapped, ast.Call) and \
+                _call_name(wrapped) in ("partial", "shard_map") and \
+                wrapped.args:
+            wrapped = wrapped.args[0]
+        else:
+            break
+    if isinstance(wrapped, ast.Name):
+        return wrapped.id
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        t = parent.targets[0]
+        if isinstance(t, ast.Attribute):
+            return t.attr
+        if isinstance(t, ast.Name):
+            return t.id
+    return mod.qualname(node) or "<module>"
+
+
+def _policy_statics(policy: str) -> str:
+    """The ``statics=...`` clause of a policy string ("" when absent)."""
+    for part in policy.split(";"):
+        part = part.strip()
+        if part.startswith("statics="):
+            return part[len("statics="):].strip()
+    return ""
+
+
+@rule("jit-compile-surface", severity="error",
+      doc="Every jax.jit / pjit / shard_map call site must be covered by "
+          "a module-level COMPILE_SURFACE = compile_surface(__name__, "
+          "{site: policy}) registry (analysis/surface.py) whose policy "
+          "carries statics= and buckets= clauses; statics declared at the "
+          "call site must match the registered statics, and sites with no "
+          "static/donate declaration must register statics=none or "
+          "statics=closure(...).  Dead registry entries are findings too.",
+      fixture_fail=_JCS_FIXTURE_FAIL, fixture_pass=_JCS_FIXTURE_PASS)
+def jit_compile_surface(project: Project):
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue                  # scripts/benches drive declared
+                                      # surfaces; they don't own one
+        sites = list(_jit_sites(mod))
+        if not sites:
+            continue
+        decl, decl_line = _surface_decl(mod)
+        if decl is None:
+            yield Finding(
+                "", "", mod.path, sites[0][0].lineno,
+                f"module has {len(sites)} jit/shard_map call site(s) but "
+                f"no COMPILE_SURFACE = compile_surface(__name__, "
+                f"{{...}}) registry declaring its shape-bucket policy",
+                anchor="COMPILE_SURFACE")
+            continue
+        used: set[str] = set()
+        for node, site, statics, kind in sites:
+            entry = decl.get(site)
+            if entry is None:
+                yield _finding(
+                    mod, node,
+                    f"{kind} call site {site!r} is not registered in this "
+                    f"module's COMPILE_SURFACE (declare its statics and "
+                    f"shape-bucket policy)")
+                continue
+            used.add(site)
+            policy, _ln = entry
+            missing = [t for t in _POLICY_TOKENS if t not in policy]
+            if missing:
+                yield _finding(
+                    mod, node,
+                    f"COMPILE_SURFACE entry {site!r} lacks the "
+                    f"{'/'.join(missing)} clause(s) of the policy grammar")
+                continue
+            declared = _policy_statics(policy)
+            if statics is None and not (
+                    declared == "none" or declared.startswith("closure(")):
+                yield _finding(
+                    mod, node,
+                    f"{kind} call site {site!r} declares no static_argnames"
+                    f"/donation but its COMPILE_SURFACE entry says "
+                    f"statics={declared!r} — declare the statics at the "
+                    f"call site or register statics=none / closure(...)")
+            elif statics:
+                reg = tuple(sorted(s.strip() for s in declared.split(",")
+                                   if s.strip()))
+                if reg and reg != statics:
+                    yield _finding(
+                        mod, node,
+                        f"{site!r} statics drift: call site declares "
+                        f"{sorted(statics)} but COMPILE_SURFACE registers "
+                        f"statics={declared!r}")
+        for site, (policy, lineno) in sorted(decl.items()):
+            if site not in used:
+                yield Finding(
+                    "", "", mod.path, lineno,
+                    f"COMPILE_SURFACE entry {site!r} matches no jit/"
+                    f"shard_map call site (dead entry — remove it or fix "
+                    f"the site name)", anchor=f"COMPILE_SURFACE.{site}")
+
+
+def compile_surface_census(project: Project) -> dict[str, int]:
+    """Static totals for the perf_sentinel-comparable smlint artifact:
+    jit/shard_map call sites, registered COMPILE_SURFACE entries, and
+    modules carrying a registry."""
+    sites = entries = modules = 0
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue
+        mod_sites = list(_jit_sites(mod))
+        sites += len(mod_sites)
+        decl, _ = _surface_decl(mod)
+        if decl:
+            modules += 1
+            entries += len(decl)
+    return {"sites": sites, "entries": entries, "modules": modules}
+
+
+# ========================================================= 8. retrace-hazard
+# Raw runtime-shape reads (`x.shape[...]`, `len(x)`, `x.size`) flowing
+# into a jitted callable's STATIC argument mint one executable per
+# distinct value — the unbounded-signature family behind r4's 81-308 s
+# cold compiles.  Static values must pass a bucketing/padding helper
+# first so every dataset size lands in a small closed set.
+_BUCKET_HELPERS = ("ions_per_chunk_for", "shape_key", "window_chunks",
+                   "ion_window_chunks")
+
+_RH_FIXTURE_FAIL = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax\n"
+        "fn = jax.jit(score, static_argnames=('b', 'w'))\n"
+        "def go(x):\n"
+        "    return fn(x, b=x.shape[0])\n"
+        "def go2(x):\n"
+        "    n = len(x)\n"
+        "    return fn(x, w=n)\n"
+    ),
+}
+_RH_FIXTURE_PASS = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax\n"
+        "fn = jax.jit(score, static_argnames=('b', 'w'))\n"
+        "def go(x):\n"
+        "    return fn(x, b=size_bucket(x.shape[0]))\n"
+        "def go2(x):\n"
+        "    n = round_up(len(x), 256)\n"
+        "    return fn(x, w=n)\n"
+    ),
+}
+
+
+def _is_shape_source(node: ast.AST) -> bool:
+    """A raw runtime-shape read: ``.shape`` / ``.size`` attribute access
+    or a ``len(...)`` call."""
+    if isinstance(node, ast.Attribute) and node.attr in ("shape", "size"):
+        return True
+    return isinstance(node, ast.Call) and _call_name(node) == "len"
+
+
+def _is_bucketing_call(node: ast.AST) -> bool:
+    """A call through a recognized bucketing/padding helper: name contains
+    ``bucket``/``round``/``pad``, or one of the named shape-plan helpers."""
+    if not isinstance(node, ast.Call):
+        return False
+    callee = _call_name(node)
+    return (callee in _BUCKET_HELPERS or
+            any(t in callee for t in ("bucket", "round", "pad")))
+
+
+def _expr_shape_taint(node: ast.AST, tainted: set[str]) -> bool:
+    """Does ``node`` carry a raw shape read (directly or through a tainted
+    local) that never passes a bucketing helper?"""
+    if any(_is_bucketing_call(n) for n in ast.walk(node)):
+        return False
+    return any(
+        _is_shape_source(n) or (
+            isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and
+            n.id in tainted)
+        for n in ast.walk(node))
+
+
+@rule("retrace-hazard", severity="error",
+      doc="Raw runtime-shape reads (.shape / .size / len()) must not flow "
+          "into a jitted callable's static arguments (the kwarg names a "
+          "module's jit sites declare via static_argnames) without "
+          "passing a bucketing/padding helper — one executable per "
+          "distinct value is the unbounded cold-compile family.",
+      fixture_fail=_RH_FIXTURE_FAIL, fixture_pass=_RH_FIXTURE_PASS)
+def retrace_hazard(project: Project):
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue
+        # the module's static-arg namespace: every literal static name any
+        # of its jit sites declares (per-module scoping keeps a common
+        # kwarg like `b` in OTHER modules out of the sink set)
+        static_names: set[str] = set()
+        for _node, _site, statics, _kind in _jit_sites(mod):
+            static_names |= set(statics or ())
+        if not static_names:
+            continue
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            tainted: set[str] = set()
+            for node in ast.walk(fn):
+                if mod.enclosing_function(node) is not fn and node is not fn:
+                    continue
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) and \
+                        _expr_shape_taint(node.value, tainted):
+                    tainted.add(node.targets[0].id)
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg not in static_names:
+                        continue
+                    if _expr_shape_taint(kw.value, tainted):
+                        yield _finding(
+                            mod, node,
+                            f"static argument {kw.arg!r} receives a raw "
+                            f"runtime shape (.shape/.size/len) without a "
+                            f"bucketing/padding helper — every distinct "
+                            f"value compiles a new executable "
+                            f"(retrace hazard)")
+
+
+# ============================================================== 9. host-sync
+# Device->host synchronization points in the HOT scoring modules: each
+# np.asarray/np.array/device_get/block_until_ready/.item() stalls the
+# async dispatch pipeline, so every one must be a deliberate, argued
+# fetch point — annotated `# smlint: host-sync-ok[reason]`.
+_HS_MODULES_EXACT = ("models/msm_jax.py", "parallel/sharded.py")
+_HS_NP_CALLS = ("asarray", "array", "ascontiguousarray")
+_HS_METHOD_CALLS = ("block_until_ready", "item")
+
+_HS_FIXTURE_FAIL = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import numpy as np\n"
+        "import jax\n"
+        "def score(fn, x):\n"
+        "    out = fn(x)\n"
+        "    out.block_until_ready()\n"
+        "    v = float(fn(x)[0])\n"
+        "    return np.asarray(out), v\n"
+    ),
+}
+_HS_FIXTURE_PASS = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import numpy as np\n"
+        "def score(fn, x):\n"
+        "    out = fn(x)\n"
+        "    # smlint: host-sync-ok[the designed per-group fetch point]\n"
+        "    return np.asarray(out)\n"
+        "def host_prep(rows):\n"
+        "    return [r + 1 for r in rows]\n"
+    ),
+}
+
+
+def _is_hot_module(path: str) -> bool:
+    if any(path.endswith(m) for m in _HS_MODULES_EXACT):
+        return True
+    return "/ops/" in path and path.endswith("_jax.py")
+
+
+def _host_sync_call(node: ast.Call) -> str | None:
+    """The sync kind when ``node`` is a device->host synchronization:
+    np.asarray/np.array/..., jax.device_get, .block_until_ready(),
+    .item(), or float()/int() directly over a call result."""
+    callee = _call_name(node)
+    chain = _attr_chain(node.func)
+    if callee in _HS_NP_CALLS and chain.split(".")[0] in ("np", "numpy"):
+        return f"np.{callee}"
+    if callee == "device_get" and "jax" in chain:
+        return "jax.device_get"
+    if callee in _HS_METHOD_CALLS and isinstance(node.func, ast.Attribute):
+        return f".{callee}()"
+    # float() directly over a call result forces the value to host; int()
+    # is excluded — it is overwhelmingly host-side index arithmetic
+    # (int(np.searchsorted(...))), not a device sync
+    if callee == "float" and len(node.args) == 1 and \
+            isinstance(node.args[0], (ast.Call, ast.Subscript)) and any(
+            isinstance(n, ast.Call) for n in ast.walk(node.args[0])):
+        return "float() on a call result"
+    return None
+
+
+@rule("host-sync", severity="error",
+      doc="Device->host syncs (np.asarray / np.array / jax.device_get / "
+          ".block_until_ready() / .item() / float() on a call result) in "
+          "the hot scoring modules (models/msm_jax.py, parallel/"
+          "sharded.py, ops/*_jax.py) must carry a `# smlint: "
+          "host-sync-ok[reason]` annotation — each sync is a deliberate "
+          "pipeline stall that must be argued, not an accident.",
+      fixture_fail=_HS_FIXTURE_FAIL, fixture_pass=_HS_FIXTURE_PASS)
+def host_sync(project: Project):
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/") or \
+                not _is_hot_module(mod.path):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _host_sync_call(node)
+            if kind is None:
+                continue
+            reason = mod.host_sync_reason(node.lineno)
+            if reason:
+                continue
+            if reason == "":
+                yield _finding(
+                    mod, node,
+                    f"host-sync-ok annotation for {kind} has an empty "
+                    f"reason — the reason is the point")
+            else:
+                yield _finding(
+                    mod, node,
+                    f"{kind} in a hot scoring module is a device->host "
+                    f"sync point — annotate `# smlint: host-sync-ok"
+                    f"[reason]` (why this stall is deliberate) or move it "
+                    f"off the hot path")
+
+
+# ========================================================== 10. broad-except
 _LOG_METHODS = {"debug", "info", "warning", "error", "exception", "critical",
                 "log", "write"}
 

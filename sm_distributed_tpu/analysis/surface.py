@@ -1,0 +1,84 @@
+"""COMPILE_SURFACE: the declared compile surface of the engine (ISSUE 12).
+
+ROADMAP item 1 (cold-start annihilation) only holds if every dataset size
+hits a small CLOSED set of compiled signatures — and nothing proved,
+statically or at runtime, that a code path can't mint an unbounded family
+of ``jax.jit`` signatures (r4 measured 81–308 s cold compiles at scale).
+This module is the declarative half of that proof:
+
+- every module that creates jitted/``shard_map``-ped executables declares
+  a module-level ``COMPILE_SURFACE = compile_surface(__name__, {...})``
+  mapping each **site name** (the wrapped function's name — see the
+  ``jit-compile-surface`` rule in ``rules.py`` for the resolution order)
+  to a **policy string** in the annotation grammar::
+
+      "statics=<n1,n2,...>|none|closure(<names>); buckets=<how the static
+       shapes are bounded>"
+
+  e.g. ``"statics=gc_width,b,k; buckets=sticky gc_width + formula_batch
+  ladder (b in {batch, 256})"``.  The ``buckets=`` clause names the
+  shape-bucketing policy that keeps the signature family finite — the
+  thing a reviewer must argue when adding a call site (the same move as
+  GSPMD treating sharding annotations as statically checkable program
+  properties, arXiv:2105.04663);
+
+- the ``jit-compile-surface`` smlint rule statically cross-checks the
+  registry against the actual call sites (missing/dead entries, statics
+  drift) so the declaration cannot rot;
+
+- the runtime retrace tracer (``retrace.py``) and the census gate
+  (``scripts/compile_census.py``) check the OBSERVED compile surface —
+  every XLA compilation attributed to a call site in a registered module,
+  and the signature set closed under repeated same-shaped traffic.
+
+The registry is import-time write-once state: modules register as they
+are imported, readers only iterate.  One leaf lock guards the map (the
+census reads while scheduler worker threads may still be importing
+backends lazily).
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_SURFACES: dict[str, dict[str, str]] = {}
+
+# tokens every policy string must carry (the jit-compile-surface rule
+# enforces the same grammar statically; keep them in lockstep)
+POLICY_TOKENS = ("statics=", "buckets=")
+
+
+def compile_surface(module: str, entries: dict[str, str]) -> dict[str, str]:
+    """Declare ``module``'s compile surface and return ``entries`` (so the
+    declaration doubles as the module-level ``COMPILE_SURFACE`` constant).
+
+    ``entries`` maps site name -> policy string; malformed policies raise
+    at import time — a bad declaration must not wait for the lint run."""
+    for site, policy in entries.items():
+        if not isinstance(policy, str) or not all(
+                t in policy for t in POLICY_TOKENS):
+            raise ValueError(
+                f"compile_surface({module!r}): entry {site!r} must be a "
+                f"policy string carrying {' and '.join(POLICY_TOKENS)} "
+                f"clauses, got {policy!r}")
+    with _lock:
+        _SURFACES[module] = dict(entries)
+    return dict(entries)
+
+
+def registered() -> dict[str, dict[str, str]]:
+    """{module name: {site: policy}} of every imported declaration."""
+    with _lock:
+        return {m: dict(e) for m, e in _SURFACES.items()}
+
+
+def module_for_path(rel_path: str) -> str:
+    """``sm_distributed_tpu/models/msm_jax.py`` -> the module name its
+    ``compile_surface(__name__, ...)`` call registered under."""
+    p = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    return p.replace("/", ".")
+
+
+def is_registered_path(rel_path: str) -> bool:
+    return module_for_path(rel_path) in registered()

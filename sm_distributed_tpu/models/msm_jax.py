@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.surface import compile_surface
 from ..io.dataset import SpectralDataset
 from ..utils import tracing
 from ..ops.imager_jax import (
@@ -49,6 +50,48 @@ from ..ops.metrics_jax import (
 from ..ops.quantize import quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
+
+# The declared compile surface of this module (ISSUE 12, analysis/surface.py):
+# every jit call site below registers its statics and the shape-bucket policy
+# that keeps its signature family FINITE — the jit-compile-surface rule
+# cross-checks these entries against the AST, and scripts/compile_census.py
+# proves the observed runtime surface matches and stays closed.
+COMPILE_SURFACE = compile_surface(__name__, {
+    "fused_score_fn_chunked":
+        "statics=gc_width,b,k; buckets=one executable per dataset config — "
+        "b=formula_batch (batches padded), k=stream max_peaks, "
+        "gc_width=mz_chunk knob",
+    "fused_score_fn_flat_banded":
+        "statics=gc_width,b,k; buckets=b in {formula_batch, 256 tail}, "
+        "sticky stream-max gc_width (_grow_for_stream fixpoint), k=stream "
+        "max_peaks",
+    "fused_score_fn_flat_banded_compact":
+        "statics=gc_width,b,k,n_keep; buckets=flat-banded statics + n_keep "
+        "rounded to 64k sticky capacity (_grow_compact_capacity)",
+    "fused_score_fn_flat_banded_sliced":
+        "statics=gc_width,b,k,w_cap; buckets=flat-banded statics + w_cap on "
+        "the {1,1.5}x pow-2 band_bucket ladder (ops/imager_jax.band_bucket)",
+    "extract_images":
+        "statics=none; buckets=one executable per backend — cube-path image "
+        "export at the padded (b, k) batch shape",
+    "extract_images_flat":
+        "statics=closure(n_pixels); buckets=one executable per backend — "
+        "flat-path image export at the padded (b, k) batch shape",
+    "ext_base":
+        "statics=closure(n_pixels,gc_width,n_keep,w_cap); buckets=probe-only "
+        "re-jit of the production extraction variant (probe_phases inherits "
+        "the sticky production statics, so no new shapes are minted)",
+    "batch_moments":
+        "statics=none; buckets=probe-only — one shape per probed batch "
+        "(the padded production (b, k, P) block)",
+    "measure_of_chaos_batch":
+        "statics=closure(nrows,ncols,nlevels); buckets=probe-only — image "
+        "geometry is per-dataset static",
+    "correlation_from_moments":
+        "statics=none; buckets=probe-only — padded (b, k) metric epilogue",
+    "isotope_pattern_match_batch":
+        "statics=none; buckets=probe-only — padded (b, k) metric epilogue",
+})
 
 
 def _maybe_barrier(imgs: jnp.ndarray, k: int, n_pix: int) -> jnp.ndarray:
@@ -293,6 +336,7 @@ def to_numpy_global(arr) -> np.ndarray:
     a per-process decision could leave only some processes entering the
     collective and deadlock the SPMD program (advisor r3)."""
     if getattr(arr, "is_fully_addressable", True):
+        # smlint: host-sync-ok[the designed result-fetch point; callers sync only after the whole group is enqueued]
         return np.asarray(arr)
 
     def _key(idx) -> tuple:
@@ -313,9 +357,11 @@ def to_numpy_global(arr) -> np.ndarray:
             or any(keys != global_keys for keys in by_proc.values())):
         from jax.experimental import multihost_utils
 
+        # smlint: host-sync-ok[multi-host fetch fallback; the allgather IS the sync, every process takes it in lockstep]
         return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
     out = np.empty(arr.shape, arr.dtype)
     for sh in arr.addressable_shards:
+        # smlint: host-sync-ok[per-shard assembly of a replicated output]
         out[sh.index] = np.asarray(sh.data)
     return out
 
@@ -712,6 +758,7 @@ class JaxBackend:
             statics["b"], statics["k"], -1)[:, :, : self.ds.n_pixels]
         nv_p, ints_p = args[-1], args[-2]
         valid_d = jax.device_put(
+            # smlint: host-sync-ok[probe-only fetch of the tiny n_valid vector; probes time phases, not dispatch]
             np.arange(statics["k"])[None, :] < np.asarray(nv_p)[:, None])
         # the metric probes mirror the PRODUCTION route exactly
         # (batch_metrics): one fused moments pass feeds chaos thresholds
@@ -740,6 +787,7 @@ class JaxBackend:
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         out, n = self._dispatch(table)
+        # smlint: host-sync-ok[single-batch API; the caller asked for the result — pipelined callers use score_batches]
         return np.asarray(out)[:n].astype(np.float64)
 
     def extract_ion_images(self, table: IsotopePatternTable) -> np.ndarray:
@@ -774,6 +822,7 @@ class JaxBackend:
             imgs = self._extract_fn(
                 self._px_s, self._in_s, jax.device_put(pos),
                 jax.device_put(r_lo), jax.device_put(r_hi))
+        # smlint: host-sync-ok[image EXPORT; the annotated-subset fetch to host is the product of this method]
         imgs = np.array(imgs).reshape(b, k, -1)[:n, :, : self.ds.n_pixels]
         imgs /= np.float32(self.int_scale)  # exact power-of-two division
         # zero out padded isotope peaks (window [0,0) is empty anyway, but

@@ -42,6 +42,22 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis.surface import compile_surface
+
+# Declared compile surface (ISSUE 12, analysis/surface.py): both kernels'
+# statics are per-dataset image geometry plus fixed tuning constants, so
+# each dataset config compiles exactly one executable per kernel.
+COMPILE_SURFACE = compile_surface(__name__, {
+    "chaos_count_sums":
+        "statics=nrows,ncols,nlevels,lane_width,interpret,work_span; "
+        "buckets=one executable per dataset — geometry is per-dataset "
+        "static, lane_width/work_span/nlevels are config constants",
+    "chaos_count_sums_strips":
+        "statics=nrows,ncols,nlevels,interpret,work_span,strip_rows; "
+        "buckets=one executable per dataset — strip_rows derives from the "
+        "fixed strip geometry of (nrows, ncols)",
+})
+
 _BIG = np.int32(2**30)
 
 

@@ -78,7 +78,9 @@ def window_rank_grid(
     iff lo_q[w] <= mz_q < hi_q[w], and #\\{mz_q < b\\} == #peaks whose grid
     bin is <= leftmost_rank(b) (strictly-below counting survives duplicate
     bounds because equal bounds share the leftmost rank)."""
+    # smlint: host-sync-ok[host window-bound prep; inputs are host numpy, not device values]
     lo_flat = np.ascontiguousarray(lo_q, dtype=np.int32).ravel()
+    # smlint: host-sync-ok[host window-bound prep; inputs are host numpy, not device values]
     hi_flat = np.ascontiguousarray(hi_q, dtype=np.int32).ravel()
     # NOTE: the grid keeps duplicate bounds (fixed 2W size) on purpose — a
     # deduplicated grid has a data-dependent length, and every new length is
@@ -360,6 +362,7 @@ def batch_peak_band(mz_host: np.ndarray, lo_q: np.ndarray,
     if flat.size == 0:
         return 0, 0
     cuts = np.searchsorted(
+        # smlint: host-sync-ok[host band-bound pair; mz_host is the host copy of the sorted peaks]
         mz_host, np.array([flat[0], flat[-1]], dtype=mz_host.dtype),
         side="left")
     return int(cuts[0]), int(cuts[1] - cuts[0])
@@ -369,7 +372,9 @@ def merged_window_bounds(lo_q: np.ndarray, hi_q: np.ndarray) -> np.ndarray:
     """Host-side: the union of half-open quantized windows [lo, hi) as a
     flat sorted boundary array [lo1, hi1, lo2, hi2, ...] of DISJOINT
     intervals.  Membership test: searchsorted(flat, mz, 'right') is odd."""
+    # smlint: host-sync-ok[host window-bound prep; inputs are host numpy, not device values]
     lo = np.asarray(lo_q, dtype=np.int64).ravel()
+    # smlint: host-sync-ok[host window-bound prep; inputs are host numpy, not device values]
     hi = np.asarray(hi_q, dtype=np.int64).ravel()
     real = lo < hi                       # drop empty windows (batch padding)
     lo, hi = lo[real], hi[real]
@@ -475,6 +480,7 @@ def batch_peak_runs(
     starts, lens = starts[keep], lens[keep]
     if starts.size == 0:     # batch with no real windows (all padding)
         return (np.zeros(0, np.int32), np.zeros(0, np.int32), 0,
+                # smlint: host-sync-ok[pos is the host-computed bound-rank array]
                 np.zeros(np.asarray(pos).shape, np.int32))
     kept_start = np.zeros(starts.size + 1, dtype=np.int64)
     np.cumsum(lens, out=kept_start[1:])
@@ -617,7 +623,9 @@ def ion_window_chunks(
     Requires ``ions_per_chunk`` to divide ``b`` (static batches are
     powers of two; callers clamp).  gc_width uses the same {1, 1.5} x
     pow-2 ladder as window_chunks."""
+    # smlint: host-sync-ok[host chunk planning over the host bound-rank arrays]
     r_lo2 = np.asarray(r_lo).reshape(b, k)
+    # smlint: host-sync-ok[host chunk planning over the host bound-rank arrays]
     r_hi2 = np.asarray(r_hi).reshape(b, k)
     empty = r_lo2 >= r_hi2
     all_empty = empty.all(axis=1)

@@ -46,7 +46,20 @@ import functools
 
 import numpy as np
 
+from ..analysis.surface import compile_surface
 from .isocalc import SEGMENT_GRID_CAP
+
+# Declared compile surface (ISSUE 12, analysis/surface.py): the blur->
+# centroid kernel closes over its (grid, states, rows, k) shape — one
+# executable per (state-bucket x grid-bucket) cell of the FIXED ladders
+# below, so the family is bounded by len(_STATE_BUCKETS) x
+# len(_GRID_BUCKETS) regardless of corpus.
+COMPILE_SURFACE = compile_surface(__name__, {
+    "run":
+        "statics=closure(lc,sc,b,k); buckets=one executable per "
+        "(_STATE_BUCKETS x _GRID_BUCKETS) cell — fixed ladders, row count "
+        "derived from the bucket (_BLOCK_ROWS), k from config n_peaks",
+})
 
 # per-segment state-count buckets: padding within a bucket costs masked
 # zeros, a new bucket costs one XLA compile.  Finer at the small end, where
@@ -212,11 +225,14 @@ class DeviceBlurCentroid:
                     ab[bi, : a.size] = a
                     ln[bi] = npts
                 outs = kern(m_rel, ab, ln)
+                # smlint: host-sync-ok[host index list, not a device value]
                 g = np.asarray(group)
                 for dst, src in zip((v, li, y0, y1, y2, gm, fb), outs):
+                    # smlint: host-sync-ok[per-bucket kernel-result fetch; top-k selection and f64 assembly are host-side by design]
                     dst[g] = np.asarray(src)[: len(group)]
-        return self._assemble(seg_lists, np.asarray(seg_ion),
-                              np.asarray(seg_pos), np.asarray(seg_lo),
+        # smlint: host-sync-ok[host segment bookkeeping lists, not device values]
+        seg_maps = (np.asarray(seg_ion), np.asarray(seg_pos), np.asarray(seg_lo))
+        return self._assemble(seg_lists, *seg_maps,
                               v, li, y0, y1, y2, gm, fb)
 
     def _assemble(self, seg_lists, seg_ion, seg_pos, seg_lo,
@@ -255,8 +271,9 @@ class DeviceBlurCentroid:
             seg_best[seg_ion[match]] = np.nonzero(match)[0]
             for i in np.nonzero(none)[0]:
                 si = seg_best[i]
-                hh, oo = _parabola(fb[si, 1], fb[si, 0], fb[si, 2],
-                                   np.asarray(gm[si]))
+                # smlint: host-sync-ok[gm was fetched with its bucket above; this is host numpy indexing]
+                gm_i = np.asarray(gm[si])
+                hh, oo = _parabola(fb[si, 1], fb[si, 0], fb[si, 2], gm_i)
                 sel_h[i, 0] = float(hh)
                 sel_mz[i, 0] = seg_lo[si] + self.step * float(oo)
                 n_valid[i] = 1

@@ -32,6 +32,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from ..analysis.surface import compile_surface
+
+# Declared compile surface (ISSUE 12, analysis/surface.py).
+COMPILE_SURFACE = compile_surface(__name__, {
+    "batch_moments_pallas":
+        "statics=interpret; buckets=one executable per padded (N, K, P) "
+        "batch shape — N/K ride the formula_batch padding, P is "
+        "per-dataset static",
+})
+
 # VMEM budget for one ion's (K, P) row block, in f32 cells.  The block is
 # sublane-padded to 8 rows (K=4 -> 2x), and the per-tile transients are
 # small, so 2M cells =~ 8 MB padded stays well inside the 16 MB scoped

@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..analysis.surface import compile_surface
 from ..io.dataset import SpectralDataset
 from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
@@ -59,6 +60,21 @@ from ..ops.quantize import quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
 from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh, shard_map
+
+# Declared compile surface (ISSUE 12, analysis/surface.py): the sharded
+# step's statics ride in through make()'s partial closure, so the whole
+# mesh path mints ONE executable per (gc_width, n_keep, w_cap) triple —
+# sticky stream-fixpoint capacities keep the triple set closed per stream.
+COMPILE_SURFACE = compile_surface(__name__, {
+    "step":
+        "statics=closure(gc_width,n_keep,w_cap); buckets=one executable per "
+        "(gc_width, n_keep, w_cap) triple — sticky _grow_static_shapes "
+        "fixpoint + band_bucket ladder bound the triple set per stream; the "
+        "extract_ion_images step is a second, statics-free export program",
+    "sharded":
+        "statics=closure(gc_width,n_keep,w_cap); buckets=jit of the "
+        "shard_mapped step, cached per triple in ShardedJaxBackend._fns",
+})
 
 
 def build_sharded_score_factory(
@@ -546,6 +562,7 @@ class ShardedJaxBackend:
             jax.device_put(np.concatenate(poss, axis=1), self._pos_sharding),
             jax.device_put(np.concatenate(rlo_l), self._nv_sharding),
             jax.device_put(np.concatenate(rhi_l), self._nv_sharding))
+        # smlint: host-sync-ok[image EXPORT; assembling the both-axes-sharded output on host is the method's product]
         imgs = np.array(
             to_numpy_global(out)).reshape(b, k, -1)[:n, :, : self.ds.n_pixels]
         imgs /= np.float32(self.int_scale)   # exact power-of-two division

@@ -412,8 +412,13 @@ class FleetController:
                      if p.get("alive")}
         for c in children:
             if not c.registered and c.rid in alive_ids:
-                c.registered = True
-                if c.draining and not self.registry.drain_requested(c.rid):
+                # child drain-sentinel state rides the same lock as the
+                # child table (ISSUE 12 satellite): status()/metric reads
+                # must never see a half-applied registered/draining pair
+                with self._lock:
+                    c.registered = True
+                    re_request = c.draining
+                if re_request and not self.registry.drain_requested(c.rid):
                     # the victim registered AFTER the drain request and
                     # wiped the sentinel (register clears prior-incarnation
                     # drains) — re-request against the live incarnation

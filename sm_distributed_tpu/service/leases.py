@@ -53,6 +53,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 import time
 import zlib
 from dataclasses import dataclass
@@ -331,12 +332,20 @@ class ReplicaRegistry:
     epoch so a restarted replica is distinguishable from its previous
     life (leases carry the epoch)."""
 
+    # smlint guarded-by registry (ISSUE 12 satellite, docs/ANALYSIS.md):
+    # the replica loop re-registers after a drain clear while API /peers
+    # handlers and the fleet controller's reconcile thread read epoch for
+    # lease stamping / drain acks — the epoch bump must be atomic with the
+    # sentinel clear it pairs with.
+    _GUARDED_BY = {"epoch": "_lock"}
+
     def __init__(self, queue_root: str | Path, replica_id: str,
                  stale_after_s: float = 8.0):
         self.dir = Path(queue_root) / "replicas"
         self.dir.mkdir(parents=True, exist_ok=True)
         self.replica_id = replica_id
         self.stale_after_s = stale_after_s
+        self._lock = threading.Lock()
         self.epoch = 0
 
     def _path(self, rid: str) -> Path:
@@ -353,7 +362,8 @@ class ReplicaRegistry:
         that wanted the old process gone saw it exit; if it still wants
         this one gone it re-requests (docs/SERVICE.md "Elasticity model")."""
         prior = self._read(self.replica_id) or {}
-        self.epoch = int(prior.get("epoch", 0)) + 1
+        with self._lock:
+            self.epoch = int(prior.get("epoch", 0)) + 1
         self.clear_drain(self.replica_id)
         try:
             (self.dir / f".{self.replica_id}.json.tmp").unlink(missing_ok=True)

@@ -99,6 +99,15 @@ class AnnotationService:
         # HBM-OOM adaptive-scoring telemetry (models/oom.py): events,
         # converged backoffs, and the learned safe batch on /metrics
         oom.attach_metrics(self.metrics)
+        # compile-retrace attribution (ISSUE 12, analysis/retrace.py):
+        # every XLA compilation this process pays for is attributed to its
+        # call site + abstract signature (sm_compile_* on /metrics, a
+        # `compile` event on the owning job's trace) — the runtime half of
+        # the COMPILE_SURFACE closed-signature-set invariant
+        if self.sm_config.telemetry.retrace:
+            from ..analysis import retrace
+
+            retrace.enable(metrics=self.metrics)
         self.scheduler = JobScheduler(
             queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
             admission=self.admission, trace_dir=self.trace_dir, slo=self.slo,

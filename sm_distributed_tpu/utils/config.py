@@ -388,6 +388,9 @@ class TelemetryConfig:
     enabled: bool = True                 # start the sampling thread
     sample_interval_s: float = 5.0       # device/occupancy sample cadence
     timeseries_len: int = 720            # snapshot ring capacity (1 h @ 5 s)
+    retrace: bool = True                 # compile-attribution tracer
+                                         # (analysis/retrace.py): sm_compile_*
+                                         # metrics + `compile` trace events
     # SLO objectives: latency threshold (seconds) + attainment target
     # (fraction of jobs that must land under the threshold)
     slo_queue_wait_s: float = 30.0       # submit -> first attempt start

@@ -1,0 +1,158 @@
+"""Retrace tracer + compile-surface registry tests (ISSUE 12).
+
+The tracer's contract: every XLA compilation is attributed to the repo
+call site that dispatched it, with its abstract signature — so a
+deliberately UNBUCKETED toy jit fn shows one signature per distinct input
+shape at THIS file's call site, while its bucketed equivalent (inputs
+padded to one static shape) shows exactly one.  That pair is the
+miniature of the whole cold-start argument (ROADMAP item 1): bucketing is
+what turns an unbounded signature family into a closed set, and the
+tracer is what makes the difference observable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sm_distributed_tpu.analysis import retrace, surface
+from sm_distributed_tpu.service.metrics import MetricsRegistry
+
+SITE_FILE = "tests/test_retrace.py"
+
+
+@pytest.fixture
+def tracer():
+    m = MetricsRegistry()
+    retrace.enable(metrics=m)
+    retrace.reset()
+    yield m
+    retrace.disable()
+    retrace.reset()
+
+
+def _my_sites(snap):
+    return {s: e for s, e in snap["sites"].items()
+            if s.startswith(SITE_FILE)}
+
+
+def _pad16(x: np.ndarray) -> np.ndarray:
+    out = np.zeros(16, dtype=np.float32)
+    out[: x.size] = x
+    return out
+
+
+def test_unbucketed_fn_mints_one_signature_per_shape(tracer):
+    f = jax.jit(lambda x: x * 2.0)
+    sizes = range(3, 8)
+    for n in sizes:
+        f(jnp.ones(n, jnp.float32))
+    snap = retrace.snapshot()
+    mine = _my_sites(snap)
+    # attribution: the compiles land on THIS test function's site
+    assert any(s.endswith(":test_unbucketed_fn_mints_one_signature_per_shape")
+               for s in mine), sorted(snap["sites"])
+    site, ent = next(iter(mine.items()))
+    lam = [s for s in ent["signatures"] if s.startswith("<lambda>")]
+    assert len(lam) == len(list(sizes)), lam   # one signature per shape
+    assert ent["events"] >= len(lam)
+
+
+def test_bucketed_equivalent_compiles_exactly_once(tracer):
+    g = jax.jit(lambda x: x * 2.0)
+    for n in range(3, 8):
+        g(jnp.asarray(_pad16(np.ones(n, np.float32))))
+    snap = retrace.snapshot()
+    mine = _my_sites(snap)
+    assert mine, sorted(snap["sites"])
+    (_site, ent), = mine.items()
+    lam = [s for s in ent["signatures"] if s.startswith("<lambda>")]
+    assert len(lam) == 1, lam                  # bucketing closes the set
+    assert "float32[16]" in lam[0]
+
+
+def test_events_grow_but_signatures_close_on_rejit(tracer):
+    """A NEW jit wrapper of the same fn may recompile (fresh executable)
+    but must not mint a new (site, signature) pair — the census's
+    closed-set check keys on exactly this."""
+    def go(fn):
+        return fn(jnp.ones(4, jnp.float32))
+
+    go(jax.jit(lambda x: x + 1.0))
+    first = retrace.snapshot()
+    go(jax.jit(lambda x: x + 1.0))
+    second = retrace.snapshot()
+
+    def sigset(snap):
+        return {(s, sig) for s, e in snap["sites"].items()
+                for sig in e["signatures"] if s.startswith(SITE_FILE)}
+
+    assert sigset(second) == sigset(first)
+    assert second["events_total"] >= first["events_total"]
+
+
+def test_metrics_and_disable(tracer):
+    f = jax.jit(lambda x: x - 1.0)
+    f(jnp.ones(5, jnp.float32))
+    text = tracer.expose()
+    assert "sm_compile_events_total{" in text
+    assert "sm_compile_signatures{" in text
+    snap = retrace.disable()
+    assert snap["events_total"] >= 1
+    # de-activated: further compiles are not recorded
+    before = retrace.snapshot()["events_total"]
+    jax.jit(lambda x: x / 2.0)(jnp.ones(6, jnp.float32))
+    assert retrace.snapshot()["events_total"] == before
+    retrace.enable()                           # restore for the fixture
+
+
+def test_compile_trace_event_emitted(tracer):
+    from sm_distributed_tpu.utils import tracing
+
+    tracing.configure(enabled=True, ring_size=64)
+    ctx = tracing.new_trace(job_id="j1")
+    with tracing.attach(ctx):
+        with tracing.span("score"):
+            jax.jit(lambda x: x * 3.0)(jnp.ones(7, jnp.float32))
+    events = [r for r in tracing.flight_recorder.recent(64)
+              if r.get("name") == "compile"]
+    assert events, "no compile event reached the flight recorder"
+    ev = events[-1]
+    assert ev["attrs"]["site"].startswith(SITE_FILE)
+    assert "signature" in ev["attrs"] and "dur_s" in ev["attrs"]
+
+
+# ------------------------------------------------------ surface registry
+def test_compile_surface_registers_and_validates():
+    entries = {"fn": "statics=none; buckets=one shape"}
+    got = surface.compile_surface("tests.fake_mod", entries)
+    assert got == entries
+    assert surface.registered()["tests.fake_mod"] == entries
+    with pytest.raises(ValueError):
+        surface.compile_surface("tests.bad_mod", {"fn": "no grammar here"})
+
+
+def test_surface_path_mapping():
+    assert surface.module_for_path(
+        "sm_distributed_tpu/models/msm_jax.py"
+    ) == "sm_distributed_tpu.models.msm_jax"
+    import sm_distributed_tpu.models.msm_jax  # noqa: F401 — registers
+    assert surface.is_registered_path("sm_distributed_tpu/models/msm_jax.py")
+    assert not surface.is_registered_path("scripts/load_sweep.py")
+
+
+def test_hot_backends_declare_their_surface():
+    """Every module the census depends on registers on import."""
+    import sm_distributed_tpu.models.msm_jax  # noqa: F401
+    import sm_distributed_tpu.ops.isocalc_jax  # noqa: F401
+    import sm_distributed_tpu.parallel.sharded  # noqa: F401
+
+    reg = surface.registered()
+    for mod in ("sm_distributed_tpu.models.msm_jax",
+                "sm_distributed_tpu.parallel.sharded",
+                "sm_distributed_tpu.ops.isocalc_jax"):
+        assert mod in reg, sorted(reg)
+        for site, policy in reg[mod].items():
+            assert "statics=" in policy and "buckets=" in policy, (mod, site)

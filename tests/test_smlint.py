@@ -131,6 +131,108 @@ def test_config_drift_both_directions():
     assert "not a SMConfig knob" in msgs   # template key absent from config
 
 
+def test_jit_compile_surface_statics_drift_and_dead_entry():
+    src = (
+        "import jax\n"
+        "from ..analysis.surface import compile_surface\n"
+        "COMPILE_SURFACE = compile_surface(__name__, {\n"
+        "    'score': 'statics=b; buckets=padded',\n"
+        "    'ghost': 'statics=none; buckets=nothing calls this',\n"
+        "})\n"
+        "def score(x, *, b, k):\n"
+        "    return x\n"
+        "fn = jax.jit(score, static_argnames=('b', 'k'))\n"
+    )
+    msgs = " | ".join(
+        f.message for f in RULES["jit-compile-surface"].run_fixture(
+            {"sm_distributed_tpu/ops/x_jax.py": src}))
+    assert "statics drift" in msgs
+    assert "dead entry" in msgs
+
+
+def test_jit_compile_surface_policy_grammar_and_shard_map_shim():
+    # missing buckets= clause fires; the mesh shim's internal jax.shard_map
+    # forwarding calls are exempt (enclosing function named shard_map)
+    src = (
+        "import jax\n"
+        "COMPILE_SURFACE = compile_surface(__name__, {\n"
+        "    'plain': 'statics=none',\n"
+        "})\n"
+        "def plain(x):\n"
+        "    return x\n"
+        "fn = jax.jit(plain)\n"
+    )
+    msgs = " | ".join(
+        f.message for f in RULES["jit-compile-surface"].run_fixture(
+            {"sm_distributed_tpu/ops/x_jax.py": src}))
+    assert "buckets=" in msgs
+    shim = (
+        "import jax\n"
+        "def shard_map(f, *, mesh, in_specs, out_specs):\n"
+        "    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,\n"
+        "                         out_specs=out_specs)\n"
+    )
+    assert not RULES["jit-compile-surface"].run_fixture(
+        {"sm_distributed_tpu/parallel/mesh.py": shim})
+
+
+def test_retrace_hazard_taints_through_locals_and_dict_sinks():
+    src = (
+        "import jax\n"
+        "fn = jax.jit(score, static_argnames=('b',))\n"
+        "def go(x):\n"
+        "    n = x.shape[0]\n"
+        "    statics = dict(b=n)\n"
+        "    return fn(x, **statics)\n"
+    )
+    got = RULES["retrace-hazard"].run_fixture(
+        {"sm_distributed_tpu/ops/x_jax.py": src})
+    assert len(got) == 1 and "retrace hazard" in got[0].message
+    # the same flow through a bucketing helper passes
+    ok = src.replace("n = x.shape[0]", "n = band_bucket(x.shape[0])")
+    assert not RULES["retrace-hazard"].run_fixture(
+        {"sm_distributed_tpu/ops/x_jax.py": ok})
+
+
+def test_host_sync_empty_reason_is_a_finding():
+    src = (
+        "import numpy as np\n"
+        "def f(out):\n"
+        "    # smlint: host-sync-ok[]\n"
+        "    return np.asarray(out)\n"
+    )
+    got = RULES["host-sync"].run_fixture(
+        {"sm_distributed_tpu/models/msm_jax.py": src})
+    assert len(got) == 1 and "empty" in got[0].message
+
+
+def test_host_sync_scoped_to_hot_modules():
+    src = "import numpy as np\ndef f(x):\n    return np.asarray(x)\n"
+    assert not RULES["host-sync"].run_fixture(
+        {"sm_distributed_tpu/engine/storage.py": src})
+    assert RULES["host-sync"].run_fixture(
+        {"sm_distributed_tpu/ops/x_jax.py": src})
+
+
+def test_cli_scopes_tests_to_broad_except_only():
+    from scripts.smlint import _scope_tests
+
+    res = run_lint(Project(modules={
+        "tests/test_x.py": (
+            "def f(m):\n"
+            "    m.counter('badname_total', 'x').inc()\n"   # conventions
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        pass\n"                                 # broad-except
+        ),
+    }), only={"metrics-conventions", "broad-except"})
+    assert {f.rule for f in res.new} == {"metrics-conventions",
+                                         "broad-except"}
+    scoped = _scope_tests(res)
+    assert [f.rule for f in scoped.new] == ["broad-except"]
+
+
 # -------------------------------------------------------------- framework
 def test_inline_ignore_suppresses_only_that_rule():
     src = (
