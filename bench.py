@@ -248,6 +248,43 @@ def measure_floor(cfg: BenchConfig, prep: dict, n_procs: int) -> dict:
                 floor_spread_mid5=spread_mid5)
 
 
+def measure_cold(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
+    """Cold-start pins (ISSUE 13): with the persistent XLA cache CLEARED
+    (a fresh per-case dir), time (a) backend build -> first scored batch —
+    the bench analog of submit→first-annotation, the latency the leading
+    single-batch group + AOT priming attack — and (b) the full cold
+    warmup (every executable variant compiled from nothing).  Runs BEFORE
+    the warm measurement and uses its own cache dir, so the headline
+    numbers still measure the warm path."""
+    import shutil
+
+    from sm_distributed_tpu.models.msm_basic import make_backend
+    from sm_distributed_tpu.utils.config import SMConfig
+    from sm_distributed_tpu.utils.logger import logger
+
+    cold_dir = cache_dir / f"xla_cold_{cfg.name}"
+    shutil.rmtree(cold_dir, ignore_errors=True)
+    sm_config = SMConfig.from_dict(
+        {"backend": "jax_tpu",
+         "fdr": {"decoy_sample_size": cfg.decoy_sample_size},
+         "parallel": {"formula_batch": cfg.formula_batch,
+                      "compile_cache_dir": str(cold_dir)}})
+    t0 = time.perf_counter()
+    backend = make_backend("jax_tpu", prep["ds"], prep["ds_config"],
+                           sm_config, table=prep["table"])
+    backend.score_batch(prep["batches"][0])
+    first_cold = time.perf_counter() - t0
+    if hasattr(backend, "warmup"):
+        backend.warmup(prep["batches"])
+    cold_total = time.perf_counter() - t0
+    shutil.rmtree(cold_dir, ignore_errors=True)
+    logger.info("[%s] cold start: first batch %.2fs, full warmup %.2fs "
+                "(cleared persistent cache)", cfg.name, first_cold,
+                cold_total)
+    return dict(first_annotation_cold_s=first_cold,
+                cold_compile_s=cold_total)
+
+
 def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path) -> dict:
     """Warm every executable variant, then time the pipelined stream —
     median of 5 full streams with the spread in the JSON, the same
@@ -437,8 +474,9 @@ def measure_multichip(cfg: BenchConfig, prep: dict, cache_dir: Path,
 
 
 def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
-           cfg: BenchConfig | None = None) -> dict:
+           cfg: BenchConfig | None = None, cold: dict | None = None) -> dict:
     iso = iso or {}
+    cold = cold or {}
     # per-phase wall clock (ISSUE 5 satellite): BENCH_*.json trajectories
     # explain WHERE time moved, not just totals.  stream_s is the median
     # full-stream wall; floor_rep_s one full floor-sample numpy rep.
@@ -463,6 +501,13 @@ def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
         "numpy_floor_multiproc_ions_per_s": round(floor["mp_rate"], 2),
         "vs_baseline_multiproc": round(jaxr["jax_rate"] / floor["mp_rate"], 2),
         "compile_s": round(jaxr["compile_dt"], 2),
+        # ISSUE 13 pinned cold-start fields (sentinel-guarded; None when
+        # --skip-cold): measured against a CLEARED persistent cache —
+        # the warm headline above never covers the first-user experience
+        "cold_compile_s": (round(cold["cold_compile_s"], 2)
+                           if cold else None),
+        "first_annotation_cold_s": (
+            round(cold["first_annotation_cold_s"], 2) if cold else None),
         "warmup_retried": bool(jaxr.get("warmup_retried", False)),
         "warmup_skipped": bool(jaxr.get("warmup_skipped", False)),
         # ISSUE 6 pinned fields: device identity + HBM high-water mark
@@ -540,6 +585,9 @@ def main() -> None:
                     help="skip the 512x512 (262k px) DESI-scale case")
     ap.add_argument("--skip-isocalc-cold", action="store_true",
                     help="skip the headline case's cold isocalc regeneration")
+    ap.add_argument("--skip-cold", action="store_true",
+                    help="skip the cleared-cache cold-start measurement "
+                         "(cold_compile_s / first_annotation_cold_s)")
     ap.add_argument("--isocalc-device", action="store_true",
                     help="route the cold isocalc measurement through the "
                          "device blur->centroid stage (ops/isocalc_jax.py)")
@@ -609,15 +657,21 @@ def main() -> None:
     iso_cold = (None if args.skip_isocalc_cold else
                 measure_isocalc_cold(configs[0], preps[0], n_procs,
                                      args.isocalc_device))
+    # cold-start pins first (ISSUE 13): fresh per-case cache dirs, so the
+    # shared-cache warm measurement below is untouched
+    colds = [None if args.skip_cold else measure_cold(c, p, cache_dir)
+             for c, p in zip(configs, preps)]
     jaxrs = [measure_jax(c, p, cache_dir) for c, p in zip(configs, preps)]
 
     out = {
         "metric": "ions_scored_per_sec_per_chip",
         "unit": "ions/s",
-        **report(preps[0], floors[0], jaxrs[0], iso_cold, configs[0]),
+        **report(preps[0], floors[0], jaxrs[0], iso_cold, configs[0],
+                 cold=colds[0]),
     }
-    for cfg, p, f, j in zip(configs[1:], preps[1:], floors[1:], jaxrs[1:]):
-        out[cfg.name] = report(p, f, j, cfg=cfg)
+    for cfg, p, f, j, cd in zip(configs[1:], preps[1:], floors[1:],
+                                jaxrs[1:], colds[1:]):
+        out[cfg.name] = report(p, f, j, cfg=cfg, cold=cd)
     if args.devices > 1:
         # multichip rides the LAST case (desi on a default run — the
         # acceptance target — else whatever case this invocation built)

@@ -119,6 +119,17 @@ if ! env JAX_PLATFORMS=cpu python scripts/multichip_smoke.py; then
     exit 1
 fi
 
+# cold-start smoke gate (ISSUE 13): a cleared-persistent-cache 64x64
+# submit through the real service must deliver its first FDR-rankable
+# annotations in < 5 s (proven via /slo attainment), with the trace
+# pinning the compile/queue/compute split + first_annotation ordering,
+# the streamed `partial` results field populated, and the recorded
+# shape-bucket lattice primeable in one pass
+if ! env JAX_PLATFORMS=cpu python scripts/coldstart_smoke.py; then
+    echo "check_tier1: FAIL — cold-start smoke gate failed" >&2
+    exit 1
+fi
+
 # resource-exhaustion smoke gate (ISSUE 10): the spheroid fixture through
 # the real service under a 64 MB disk budget — trace-drop degrade visible
 # on /metrics with golden results, 507 shed at the submit floor, recovery

@@ -11,10 +11,17 @@ repeated same-shaped traffic, through the REAL service stack:
    a ``COMPILE_SURFACE`` registration (**zero unattributed compiles**;
    driver/test frames and ``<external>`` sites fail the gate);
 2. a SECOND identical-shape job (new dataset id, same geometry) re-runs —
-   it may recompile (fresh backend, no persistent cache) but must add
-   **zero new signatures**: the signature set is closed, which is exactly
-   the property cold-start annihilation (ROADMAP item 1) needs;
-3. a ``devices: 2`` submit on a virtual 2-chip CPU mesh exercises the
+   it may re-request compiles (fresh backend) but must add **zero new
+   signatures**: the signature set is closed, which is exactly the
+   property cold-start annihilation (ROADMAP item 1) needs;
+3. cross-SIZE closure (ISSUE 13 shape-bucket lattice): a job on a
+   DIFFERENT dataset geometry (6x8 px vs 8x8 px) that shares the lattice
+   bucket (row_bucket(6) == row_bucket(8) == 8; both peak counts under
+   the 4096-slot floor) must add **zero compile events** — every
+   executable request resolves as a persistent-cache load
+   (``cache_hits`` in the retrace census), proving the signature set is
+   closed across dataset SIZES, not just identical shapes;
+4. a ``devices: 2`` submit on a virtual 2-chip CPU mesh exercises the
    pjit/shard_map SHARDED path — its compiles must attribute to the
    registered ``parallel/sharded.py`` surface the same way;
 4. ``sm_compile_events_total`` / ``sm_compile_signatures`` are live on
@@ -125,6 +132,43 @@ def run(work: Path) -> int:
                 f"signature set NOT closed — a second identical-shape job "
                 f"minted {len(new_sigs)} new signature(s): "
                 f"{sorted(new_sigs)[:5]}")
+
+        # ---- phase 2b: closure across dataset SIZES sharing a bucket
+        # (ISSUE 13): a 6x8 fixture row-buckets to the same 8-row lattice
+        # point as the 8x8 one (and both peak counts sit under the
+        # 4096-slot floor), so with the persistent cache warm from phase
+        # 1 its job must pay ZERO compiles — only cache loads
+        from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+
+        mid_path, _mid_truth = generate_synthetic_dataset(
+            work / "fx_mid", nrows=6, ncols=8, formulas=None,
+            present_fraction=0.5, noise_peaks=30, seed=12)
+        before = retrace.snapshot()
+        msg_x = dict(_msg(fx, "fast", "census_xsize"))
+        msg_x["input_path"] = str(mid_path)      # same formulas, new size
+        status, _hd, body_x = h.submit(msg_x)
+        if status != 202:
+            return fail(f"cross-size submit returned {status}: {body_x}")
+        rows = h.wait_terminal([body_x["msg_id"]])
+        if rows[body_x["msg_id"]]["state"] != "done":
+            return fail(f"cross-size job state "
+                        f"{rows[body_x['msg_id']]['state']}: "
+                        f"{rows[body_x['msg_id']]['error']!r}")
+        after = retrace.snapshot()
+        new_events = after["events_total"] - before["events_total"]
+        new_hits = after["cache_hits_total"] - before["cache_hits_total"]
+        if new_events:
+            return fail(
+                f"signature set NOT closed across dataset sizes: the 6x8 "
+                f"job (same bucket as 8x8) paid {new_events} compile(s) "
+                f"instead of resolving from the persistent cache")
+        if new_hits <= 0:
+            return fail(
+                "cross-size job neither compiled nor loaded from the "
+                "persistent cache — the census saw nothing (vacuous "
+                "cross-size stage)")
+        print(f"compile_census: cross-size closure OK — 6x8 job resolved "
+              f"{new_hits} executable(s) as cache loads, 0 compiles")
 
         # ---- phase 3: the sharded path attributes the same way
         status, _hd, body3 = h.submit(

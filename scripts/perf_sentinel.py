@@ -56,7 +56,11 @@ _BENCH_RATE_KEYS = ("value", "patterns_per_s", "pixels_per_s",
                     # the scaling ratio itself are all higher-is-better
                     "single_chip_ions_per_s", "speedup_vs_single_chip")
 _BENCH_TIME_KEYS = ("compile_s", "isocalc_s", "isocalc_cold_s",
-                    "single_chip_compile_s")
+                    "single_chip_compile_s",
+                    # ISSUE 13: cleared-cache cold-start pins — the
+                    # sentinel band-checks the COLD path, not just the
+                    # warm headline
+                    "cold_compile_s", "first_annotation_cold_s")
 # nested bench cases ride along ("multichip" appears on --devices N runs)
 _CASE_KEYS = ("scale", "desi", "multichip")
 

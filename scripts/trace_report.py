@@ -114,8 +114,27 @@ def summarize(records: list[dict]) -> dict:
             "device_token_wait_s": round(token_wait, 6),
             "device_token_hold_s": round(token_hold, 6),
             "compute_s": round(phases.get("score", {}).get("seconds", 0.0), 6),
+            # XLA compile split (ISSUE 13): real backend compiles vs
+            # persistent-cache loads, from the retrace tracer's `compile`
+            # events (analysis/retrace.py) — the cold-start cost this
+            # job itself paid, and what a primed cache turned into loads
+            "compile_s": round(sum(
+                float((r.get("attrs") or {}).get("dur_s", 0.0))
+                for r in _events(records, "compile")
+                if not (r.get("attrs") or {}).get("cached")), 6),
+            "compile_cache_load_s": round(sum(
+                float((r.get("attrs") or {}).get("dur_s", 0.0))
+                for r in _events(records, "compile")
+                if (r.get("attrs") or {}).get("cached")), 6),
             "isocalc_gen_s": round(sum(
                 float(r["dur"]) for r in _spans(records, "isocalc_gen")), 6),
+            # submit → first FDR-rankable annotations (the streamed
+            # first-results latency, matching sm_slo_first_annotation)
+            "first_annotation_s": round(
+                min((e["ts"] for e in _events(records, "first_annotation")),
+                    default=root["ts"] if root else 0.0)
+                - (root["ts"] if root else 0.0), 6)
+            if root and any(_events(records, "first_annotation")) else None,
         },
         "attempts": [{
             "attempt": (r.get("attrs") or {}).get("attempt"),
@@ -173,6 +192,14 @@ def render(s: dict) -> str:
                  f"{_pct(a['device_token_hold_s'], total)}")
     lines.append(f"  compute (score)        {a['compute_s']:9.3f}s "
                  f"{_pct(a['compute_s'], total)}")
+    lines.append(f"  xla compile            {a['compile_s']:9.3f}s "
+                 f"{_pct(a['compile_s'], total)}")
+    lines.append(f"  xla cache loads        {a['compile_cache_load_s']:9.3f}s "
+                 f"{_pct(a['compile_cache_load_s'], total)}")
+    if a.get("first_annotation_s") is not None:
+        lines.append(f"  first annotation at    "
+                     f"{a['first_annotation_s']:9.3f}s "
+                     f"{_pct(a['first_annotation_s'], total)}")
     lines.append(f"  isocalc generation     {a['isocalc_gen_s']:9.3f}s "
                  f"(overlaps other phases)")
     lines.append("")

@@ -39,10 +39,14 @@ from pathlib import Path
 from ..utils import tracing
 from ..utils.logger import logger
 
-# the jax monitoring event fired once per backend compile (cache miss);
-# trace-time events are ignored — retraces that HIT the executable cache
-# are cheap, the compile is what cold-start pays for
+# the jax monitoring event fired once per backend-compile REQUEST.  It
+# wraps ``compile_or_get_cached``, so it fires on persistent-cache HITS
+# too (jax 0.4.x) — the hit is announced by a separate cache-hits event
+# just before the duration event lands on the same thread, which is how
+# the listener below tells a real compile from a cache load (ISSUE 13:
+# a primed cache must show up as loads, not compiles).
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
 _SELF = Path(__file__).resolve()
@@ -57,19 +61,24 @@ class _Census:
     """Process-global compile census (smlint guarded-by)."""
 
     _GUARDED_BY = {"_sites": "_lock", "_events_total": "_lock",
-                   "_overflow": "_lock"}
+                   "_overflow": "_lock", "_cache_hits_total": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()
         self._sites: dict[str, dict] = {}   # site -> {signatures:set, events:int}
         self._events_total = 0
+        self._cache_hits_total = 0          # persistent-cache loads (primed)
         self._overflow = 0                  # signatures dropped past the cap
 
+    def _entry_locked(self, site: str) -> dict:
+        return self._sites.setdefault(
+            site, {"signatures": set(), "events": 0, "cache_hits": 0})
+
     def record(self, site: str, signature: str) -> tuple[bool, int]:
-        """Returns (is_new_signature, distinct_count_for_site)."""
+        """A REAL backend compile.  Returns (is_new_signature,
+        distinct_count_for_site)."""
         with self._lock:
-            ent = self._sites.setdefault(
-                site, {"signatures": set(), "events": 0})
+            ent = self._entry_locked(site)
             ent["events"] += 1
             self._events_total += 1
             new = signature not in ent["signatures"]
@@ -80,15 +89,24 @@ class _Census:
                     ent["signatures"].add(signature)
             return new, len(ent["signatures"])
 
+    def record_cache_hit(self, site: str) -> None:
+        """A persistent-cache LOAD: the executable came off disk — the
+        outcome priming buys — so it must not count as a compile."""
+        with self._lock:
+            self._entry_locked(site)["cache_hits"] += 1
+            self._cache_hits_total += 1
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "events_total": self._events_total,
+                "cache_hits_total": self._cache_hits_total,
                 "signatures_total": sum(
                     len(e["signatures"]) for e in self._sites.values()),
                 "overflow": self._overflow,
                 "sites": {
                     s: {"events": e["events"],
+                        "cache_hits": e.get("cache_hits", 0),
                         "signatures": sorted(e["signatures"])}
                     for s, e in sorted(self._sites.items())
                 },
@@ -98,6 +116,7 @@ class _Census:
         with self._lock:
             self._sites.clear()
             self._events_total = 0
+            self._cache_hits_total = 0
             self._overflow = 0
 
 
@@ -107,6 +126,10 @@ _active = False
 _registered = False
 _metrics = None
 _warned = False
+# per-thread persistent-cache-hit flag: jax announces a hit with
+# CACHE_HIT_EVENT just before the wrapping COMPILE_EVENT duration lands on
+# the same thread; the duration listener consumes the flag to classify
+_tls = threading.local()
 
 
 def _site_of_frame(frame) -> str | None:
@@ -147,15 +170,39 @@ def _attribute() -> tuple[str, str, str]:
     return site, fn_name, sig
 
 
+def _on_event(name: str, **_kw) -> None:
+    """record_event listener: flags a persistent-cache hit for the
+    duration event that follows on this thread."""
+    if name == CACHE_HIT_EVENT and _active:
+        _tls.cache_hit = True
+
+
 def _on_event_duration(name: str, duration: float, **_kw) -> None:
     global _warned
     if name != COMPILE_EVENT or not _active:
         return
     try:
+        cached = bool(getattr(_tls, "cache_hit", False))
+        _tls.cache_hit = False
         site, fn_name, sig = _attribute()
         signature = f"{fn_name}{sig}" if fn_name else sig
-        new, distinct = _census.record(site, signature)
         m = _metrics
+        if cached:
+            # the executable came off the persistent cache — the primed
+            # outcome, NOT a compile: counted separately so the census
+            # (and the coldstart smoke) can assert "loads, not compiles"
+            _census.record_cache_hit(site)
+            if m is not None:
+                m.counter(
+                    "sm_compile_cache_hits_total",
+                    "Persistent-XLA-cache executable loads (primed/warm "
+                    "cache) by attributed call site",
+                    ("site",)).labels(site=site).inc()
+            tracing.event("compile", site=site, fn=fn_name,
+                          signature=sig[:500],
+                          dur_s=round(float(duration), 4), cached=True)
+            return
+        new, distinct = _census.record(site, signature)
         if m is not None:
             m.counter(
                 "sm_compile_events_total",
@@ -167,7 +214,7 @@ def _on_event_duration(name: str, duration: float, **_kw) -> None:
                 "call site", ("site",)).labels(site=site).set(distinct)
         tracing.event("compile", site=site, fn=fn_name,
                       signature=sig[:500], dur_s=round(float(duration), 4),
-                      new_signature=bool(new))
+                      new_signature=bool(new), cached=False)
     except Exception:
         # a tracer fault must never fail the compile it observes
         if not _warned:
@@ -195,6 +242,7 @@ def enable(metrics=None) -> None:
                 return
             monitoring.register_event_duration_secs_listener(
                 _on_event_duration)
+            monitoring.register_event_listener(_on_event)
             _registered = True
         _active = True
 

@@ -343,6 +343,10 @@ def annotate_callback(sm_config: SMConfig, residency=None):
             # result store and the ledger commit, so a replica fenced out
             # by a peer takeover never double-commits
             fence=getattr(ctx, "fence", None),
+            # streamed first results (ISSUE 13): provisional annotations
+            # from the first scored group surface on the job record's
+            # ``partial`` field while later batches still run
+            on_partial=getattr(ctx, "set_partial", None),
         )
         # the scheduler's attempt-span context (already ambient when the
         # scheduler ran this in an _Attempt thread; attached here too so the

@@ -45,6 +45,7 @@ class SearchJob:
         device_token=None,
         cancel=None,
         fence=None,
+        on_partial=None,
     ):
         self.ds_id = ds_id
         self.ds_name = ds_name
@@ -77,6 +78,12 @@ class SearchJob:
         # before the ledger commit — the two writes that would otherwise
         # double-complete under a split-brain takeover.
         self.fence = fence
+        # streamed first results (ISSUE 13): provisional-annotation
+        # payloads from the search's first FDR-rankable group — recorded
+        # on ``last_partial`` and forwarded to ``on_partial`` (the service
+        # passes ``ctx.set_partial`` so GET /jobs shows the preview)
+        self.on_partial = on_partial
+        self.last_partial: dict = {}
         self.ledger = JobLedger(self.sm_config.storage.results_dir)
         # generation stats of the last completed run (workers, patterns/s,
         # device flag) — read by probes/benches (scripts/cold_path_bench.py)
@@ -177,6 +184,7 @@ class SearchJob:
                     prefetch=prefetch,
                     cancel=self.cancel,
                     device_indices=lease_devs,
+                    partial_observer=self._note_partial,
                 )
                 prefetch = None   # ownership passed: search() consumes/cancels
                 bundle = search.search()
@@ -268,6 +276,18 @@ class SearchJob:
                 logger.info(
                     "job failed: keeping work dir %s for resume",
                     self.work_dir.path)
+
+    def _note_partial(self, payload: dict) -> None:
+        """Provisional annotations landed (ISSUE 13): remember the latest
+        payload and forward it to the service's ``on_partial`` (exception-
+        safe — a preview consumer can never fail the job)."""
+        self.last_partial = dict(payload or {})
+        if self.on_partial is None:
+            return
+        try:
+            self.on_partial(self.last_partial)
+        except Exception:
+            logger.warning("on_partial consumer failed", exc_info=True)
 
     def _read_dataset(self) -> SpectralDataset:
         """Parse the staged imzML — or reuse the residency cache's copy,

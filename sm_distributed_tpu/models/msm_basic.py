@@ -19,6 +19,7 @@ import numpy as np
 import pandas as pd
 
 from ..io.dataset import SpectralDataset
+from ..ops import buckets as shape_buckets
 from ..ops import metrics_np
 from ..ops.fdr import FDR, DecoyAssignment
 from ..ops.imager_np import SortedPeakView, extract_ion_images
@@ -49,6 +50,14 @@ FP_DEVICE_ERROR = register_failpoint(
     "circuit breaker counts (open -> degrade to numpy -> half-open probe); "
     "raise:MemoryError injects an HBM RESOURCE_EXHAUSTED, which is a "
     "SIZING signal: batch backoff, no breaker trip (models/oom.py)")
+
+
+# Checkpoint partition format version, hashed into the search fingerprint:
+# bump whenever the group-partition RULE changes (a resume under a
+# different partition would leave unscored zero rows).  v2 = the leading
+# group is split to a single batch so the first FDR-rankable annotations
+# land while later batches still run (ISSUE 13 streamed first results).
+_PARTITION_VERSION = 2
 
 
 # First-annotation observers (ISSUE 6): called once per search when the
@@ -408,6 +417,7 @@ class MSMBasicSearch:
         prefetch: IsotopePrefetch | None = None,
         cancel=None,
         device_indices=None,
+        partial_observer=None,
     ):
         self.ds = ds
         self.formulas = list(dict.fromkeys(formulas))  # dedup, keep order
@@ -440,11 +450,20 @@ class MSMBasicSearch:
         self.last_table: IsotopePatternTable | None = None
         self.last_backend = None
         self.last_checkpoint: SearchCheckpoint | None = None
-        # effective scoring batch (ISSUE 10): the config formula_batch,
-        # capped by a previously LEARNED proven-safe size for this
-        # (dataset shape, backend, lease) — set in _score_and_rank before
-        # the fingerprint (the checkpoint partition depends on it)
-        self._batch_eff = max(1, self.sm_config.parallel.formula_batch)
+        # streamed first results (ISSUE 13): called once per search with a
+        # provisional-annotation payload when the first FDR-rankable group
+        # lands (the service threads it to the job record's `partial`
+        # field); None = no consumer
+        self.partial_observer = partial_observer
+        # effective scoring batch (ISSUE 10/13): the config formula_batch
+        # snapped to the shape-bucket lattice (ops/buckets.effective_batch
+        # — the jax backends pad with the same snap, so slicing and
+        # padding can never disagree), capped by a previously LEARNED
+        # proven-safe size for this (dataset shape, backend, lease) — set
+        # in _score_and_rank before the fingerprint (the checkpoint
+        # partition depends on it)
+        self._batch_eff = shape_buckets.effective_batch(
+            self.sm_config.parallel)
         # in-flight OOM backoff cap: once a group halves its way to a
         # fitting size, every LATER group of this search starts capped
         # there (the device backend's padding batch already shrank)
@@ -463,10 +482,12 @@ class MSMBasicSearch:
         h = hashlib.sha256()
         h.update(repr((self.ds.nrows, self.ds.ncols, int(self.ds.n_peaks),
                        img.ppm, img.nlevels, img.do_preprocessing, img.q,
-                       # the EFFECTIVE batch (== parallel.formula_batch
-                       # unless an OOM-learned safe size caps it): the
-                       # checkpoint partition is keyed on what actually ran
-                       self._batch_eff, par.checkpoint_every)).encode())
+                       # the EFFECTIVE batch (== the lattice-snapped
+                       # formula_batch unless an OOM-learned safe size caps
+                       # it): the checkpoint partition is keyed on what
+                       # actually ran, under the current partition format
+                       self._batch_eff, par.checkpoint_every,
+                       _PARTITION_VERSION)).encode())
         stride = max(1, self.ds.mzs_flat.size // 65536)
         h.update(np.ascontiguousarray(self.ds.mzs_flat[::stride]).tobytes())
         h.update(np.ascontiguousarray(self.ds.ints_flat[::stride]).tobytes())
@@ -491,10 +512,12 @@ class MSMBasicSearch:
         h = hashlib.sha256()
         h.update(repr((self.ds.nrows, self.ds.ncols, int(self.ds.n_peaks),
                        img.ppm, img.nlevels, img.do_preprocessing, img.q,
-                       # the EFFECTIVE batch (== parallel.formula_batch
-                       # unless an OOM-learned safe size caps it): the
-                       # checkpoint partition is keyed on what actually ran
-                       self._batch_eff, par.checkpoint_every)).encode())
+                       # the EFFECTIVE batch (== the lattice-snapped
+                       # formula_batch unless an OOM-learned safe size caps
+                       # it): the checkpoint partition is keyed on what
+                       # actually ran, under the current partition format
+                       self._batch_eff, par.checkpoint_every,
+                       _PARTITION_VERSION)).encode())
         stride = max(1, self.ds.mzs_flat.size // 65536)
         h.update(np.ascontiguousarray(self.ds.mzs_flat[::stride]).tobytes())
         h.update(np.ascontiguousarray(self.ds.ints_flat[::stride]).tobytes())
@@ -544,7 +567,10 @@ class MSMBasicSearch:
 
     def _oom_key(self) -> str:
         """Safe-batch registry key: what a batch's HBM footprint depends
-        on (models/oom.py)."""
+        on (models/oom.py).  Keyed on the PIXEL BUCKET, not the raw count
+        (ISSUE 13): every dataset size in a lattice bucket runs the same
+        executables at the same scratch shapes, so a learned safe batch
+        transfers across them."""
         return oom.shape_key(self.ds.n_pixels, self.sm_config.backend,
                              self.device_indices)
 
@@ -553,7 +579,10 @@ class MSMBasicSearch:
                        cap: int) -> list[tuple[int, int]]:
         """Re-split scoring slices at ``cap`` ions.  The checkpoint
         partition (group row ranges) is untouched — only the per-call
-        scoring grain shrinks, exactly like ``_reduced_slices``."""
+        scoring grain shrinks, exactly like ``_reduced_slices``.  Callers
+        pass lattice-point caps (``_oom_backoff`` snaps them down), so a
+        shrunk batch lands on a primer-enumerated executable instead of
+        minting a one-off size."""
         return [(a, min(a + cap, e))
                 for s, e in slices for a in range(s, e, cap)]
 
@@ -566,6 +595,11 @@ class MSMBasicSearch:
         but still NOT a breaker signal)."""
         cur = cap or max(e - s for s, e in slices)
         new = cur // 2
+        if new >= 1 and shape_buckets.buckets_enabled(
+                self.sm_config.parallel):
+            # snap the shrunk cap DOWN to the lattice so the backoff lands
+            # on a primer-enumerated executable (ISSUE 13)
+            new = shape_buckets.batch_bucket_down(new)
         oom.record_oom_event("score_group", str(exc))
         if new < 1:
             logger.error(
@@ -666,6 +700,57 @@ class MSMBasicSearch:
         for (s, e), out in zip(slices, outs):
             metrics[s:e] = out
         return backend, degraded
+
+    def _emit_partial(self, fdr: FDR, assignment: DecoyAssignment,
+                      table: IsotopePatternTable, metrics: np.ndarray,
+                      n_scored: int, gi: int) -> None:
+        """Provisional annotations over the scored prefix (ISSUE 13
+        streamed first results): rank the first ``n_scored`` ions' msm
+        through the REAL FDR estimator (the decoy set is the prefix's —
+        provisional by construction, converging to the final ranking as
+        groups land) and publish a small summary to the job trace and the
+        ``partial_observer`` (the service threads it into the job record's
+        ``partial`` field).  Best-effort: a failure here degrades to no
+        preview, never a failed search."""
+        if n_scored >= table.n_ions or n_scored <= 0:
+            return                    # single group: final results imminent
+        if self.partial_observer is None and not tracing.enabled():
+            return
+        try:
+            sub = pd.DataFrame({
+                "sf": table.sfs[:n_scored],
+                "adduct": table.adducts[:n_scored],
+                "msm": metrics[:n_scored, 3],
+            })
+            ann = fdr.estimate_fdr(sub, assignment)
+            top = ann.sort_values("msm", ascending=False).head(5)
+            payload = {
+                "provisional": True,
+                "group": int(gi),
+                "n_scored": int(n_scored),
+                "n_ions": int(table.n_ions),
+                "annotations": int(len(ann)),
+                "fdr_10pct": int((ann["fdr"] <= 0.1).sum()),
+                "top": [
+                    {"sf": str(r.sf), "adduct": str(r.adduct),
+                     "msm": round(float(r.msm), 6),
+                     "fdr": round(float(r.fdr), 6)}
+                    for r in top.itertuples()
+                ],
+            }
+        except Exception:
+            logger.warning("provisional partial annotations failed",
+                           exc_info=True)
+            return
+        tracing.event("partial_annotations",
+                      **{k: v for k, v in payload.items() if k != "top"})
+        obs = self.partial_observer
+        if obs is not None:
+            try:
+                obs(payload)
+            except Exception:
+                logger.warning("partial-results observer %r failed", obs,
+                               exc_info=True)
 
     def search(self) -> SearchResultsBundle:
         timings: dict[str, float] = {}
@@ -786,9 +871,16 @@ class MSMBasicSearch:
                       for s in range(0, table.n_ions, batch)]
             ckpt_every = self.sm_config.parallel.checkpoint_every
             if self.checkpoint_dir and ckpt_every > 0:
-                # group batches so pipelining still happens within a group
-                groups = [slices[i : i + ckpt_every]
-                          for i in range(0, len(slices), ckpt_every)]
+                # group batches so pipelining still happens within a
+                # group.  Streamed first results (ISSUE 13,
+                # _PARTITION_VERSION 2): the LEADING group is a single
+                # batch, so the first FDR-rankable metrics — and the
+                # provisional `partial` annotations — land after one
+                # batch's compute instead of a whole group's, while later
+                # groups keep the full pipelining grain
+                groups = [slices[: 1]] + [
+                    slices[1:][i : i + ckpt_every]
+                    for i in range(0, len(slices) - 1, ckpt_every)]
                 if self.sm_config.backend == "jax_tpu":
                     import jax
 
@@ -810,6 +902,12 @@ class MSMBasicSearch:
                 # time anyway)
                 groups, ckpt, done = [[sl] for sl in slices], None, 0
                 row_ranges = [sl for sl in slices]
+            elif len(slices) > 1:
+                # no checkpoint grain: still split the leading batch into
+                # its own group so first-annotation latency is one batch,
+                # not the whole stream (the tail stays one pipelined group)
+                groups, ckpt, done = [slices[:1], slices[1:]], None, 0
+                row_ranges = [(g[0][0], g[-1][1]) for g in groups]
             else:
                 groups, ckpt, done = [slices], None, 0
                 row_ranges = [(0, table.n_ions)] if slices else []
@@ -854,6 +952,14 @@ class MSMBasicSearch:
                     first_scored = True
                     tracing.event("first_annotation", group=gi)
                     _notify_first_annotation()
+                    # streamed first results (ISSUE 13): provisional FDR
+                    # over the scored prefix, exposed on the job trace +
+                    # the scheduler's `partial` field while later batches
+                    # still run
+                    self._emit_partial(
+                        fdr, assignment, table, metrics,
+                        row_ranges[gi][1] if row_ranges else table.n_ions,
+                        gi)
                 if ckpt is not None:
                     with tracing.span("checkpoint_save", group=gi):
                         ckpt.save(metrics, gi, len(groups), row_ranges)

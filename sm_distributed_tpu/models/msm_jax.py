@@ -20,6 +20,7 @@ import numpy as np
 
 from ..analysis.surface import compile_surface
 from ..io.dataset import SpectralDataset
+from ..ops import buckets as shape_buckets
 from ..utils import tracing
 from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
@@ -62,21 +63,25 @@ COMPILE_SURFACE = compile_surface(__name__, {
         "b=formula_batch (batches padded), k=stream max_peaks, "
         "gc_width=mz_chunk knob",
     "fused_score_fn_flat_banded":
-        "statics=gc_width,b,k; buckets=b in {formula_batch, 256 tail}, "
-        "sticky stream-max gc_width (_grow_for_stream fixpoint), k=stream "
-        "max_peaks",
+        "statics=gc_width,b,k; buckets=b in {lattice formula_batch, 256 "
+        "tail}, sticky stream-max gc_width (_grow_for_stream fixpoint), "
+        "k=stream max_peaks; dataset shapes snapped to the ops/buckets "
+        "lattice (row-bucketed pixels, peak-bucketed residents, traced "
+        "n_real) so every dataset size in a bucket shares the executable",
     "fused_score_fn_flat_banded_compact":
         "statics=gc_width,b,k,n_keep; buckets=flat-banded statics + n_keep "
         "rounded to 64k sticky capacity (_grow_compact_capacity)",
     "fused_score_fn_flat_banded_sliced":
         "statics=gc_width,b,k,w_cap; buckets=flat-banded statics + w_cap on "
-        "the {1,1.5}x pow-2 band_bucket ladder (ops/imager_jax.band_bucket)",
+        "the {1,1.125..1.875}x pow-2 band_bucket ladder "
+        "(ops/imager_jax.band_bucket)",
     "extract_images":
         "statics=none; buckets=one executable per backend — cube-path image "
         "export at the padded (b, k) batch shape",
     "extract_images_flat":
         "statics=closure(n_pixels); buckets=one executable per backend — "
-        "flat-path image export at the padded (b, k) batch shape",
+        "flat-path image export at the padded (b, k) batch shape on the "
+        "row-bucketed pixel lattice",
     "ext_base":
         "statics=closure(n_pixels,gc_width,n_keep,w_cap); buckets=probe-only "
         "re-jit of the production extraction variant (probe_phases inherits "
@@ -120,6 +125,7 @@ def fused_score_fn_flat_banded(
     inv: jnp.ndarray,          # (B*K,)
     theor_ints: jnp.ndarray,
     n_valid: jnp.ndarray,
+    n_real=None,               # () i32 traced: REAL pixel count (lattice)
     *,
     gc_width: int,
     b: int,
@@ -137,7 +143,14 @@ def fused_score_fn_flat_banded(
     The chunk plan is ION-MAJOR (ion_window_chunks): extraction emits the
     (b, k, P) block directly — no multi-GB image-row gather; ``inv`` is
     the (b,) ion inverse permutation applied to the (b, 4) METRIC rows,
-    and theor_ints / n_valid arrive already ion-sorted."""
+    and theor_ints / n_valid arrive already ion-sorted.
+
+    Shape-bucket lattice (ISSUE 13): ``nrows`` is the ROW-BUCKETED grid
+    (ops/buckets.row_bucket) and the resident peak arrays are padded to a
+    lattice capacity, so every dataset size in a bucket shares ONE
+    executable; ``n_real`` carries the true pixel count as a traced
+    scalar for the masked metric centering (bit-identical to unpadded —
+    see batch_metrics)."""
     imgs = extract_images_flat_banded(
         pixel_sorted, int_sorted, pos, starts, r_lo_loc, r_hi_loc, None,
         gc_width=gc_width, n_pixels=nrows * ncols)
@@ -145,7 +158,7 @@ def fused_score_fn_flat_banded(
     imgs = imgs.reshape(b, k, -1)
     out = batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
-        do_preprocessing=do_preprocessing, q=q,
+        do_preprocessing=do_preprocessing, q=q, n_real=n_real,
     )
     return jnp.take(out, inv, axis=0)
 
@@ -176,6 +189,7 @@ def fused_score_fn_flat_banded_sliced(
     inv: jnp.ndarray,          # (B*K,)
     theor_ints: jnp.ndarray,
     n_valid: jnp.ndarray,
+    n_real=None,               # () i32 traced: REAL pixel count (lattice)
     *,
     w_cap: int,
     gc_width: int,
@@ -209,7 +223,7 @@ def fused_score_fn_flat_banded_sliced(
     imgs = imgs.reshape(b, k, -1)
     out = batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
-        do_preprocessing=do_preprocessing, q=q,
+        do_preprocessing=do_preprocessing, q=q, n_real=n_real,
     )
     return jnp.take(out, inv, axis=0)
 
@@ -242,6 +256,7 @@ def fused_score_fn_flat_banded_compact(
     inv: jnp.ndarray,          # (B*K,)
     theor_ints: jnp.ndarray,
     n_valid: jnp.ndarray,
+    n_real=None,               # () i32 traced: REAL pixel count (lattice)
     *,
     n_keep: int,
     gc_width: int,
@@ -270,7 +285,7 @@ def fused_score_fn_flat_banded_compact(
     imgs = imgs.reshape(b, k, -1)
     out = batch_metrics(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
-        do_preprocessing=do_preprocessing, q=q,
+        do_preprocessing=do_preprocessing, q=q, n_real=n_real,
     )
     return jnp.take(out, inv, axis=0)
 
@@ -313,13 +328,37 @@ def fused_score_fn_chunked(
 
 # One row per extraction variant so the dispatch/probe sites cannot drift:
 # (jitted-scorer attr on JaxBackend, standalone extract fn, #args consumed
-# by extraction (the rest are (theor_ints, n_valid)), index of the
+# by extraction (the rest are (theor_ints, n_valid, n_real)), index of the
 # bound-ranks array in the args list)
 _VARIANTS = {
     "plain": ("_fn", extract_images_flat_banded, 5, 0),
     "compact": ("_fn_c", _extract_compact, 8, 3),
     "band": ("_fn_bs", _extract_sliced, 6, 1),
 }
+
+
+def make_flat_jits(common: dict) -> dict:
+    """The flat-path jitted scorers for one metric geometry, keyed by
+    variant name.  ``common`` is the closure dict (nrows — row-bucketed
+    under the lattice — ncols, nlevels, do_preprocessing, q).
+
+    THE one place these jits are constructed: ``JaxBackend.__init__``
+    binds them to ``self._fn*`` and the AOT cache primer
+    (``service/primer.py``) builds byte-identical programs from a recorded
+    BucketSpec — same function objects, same partial closure, same
+    static_argnames — so a primed persistent-cache entry is exactly the
+    entry a later real job looks up (ISSUE 13)."""
+    return {
+        "plain": jax.jit(
+            partial(fused_score_fn_flat_banded, **common),
+            static_argnames=("gc_width", "b", "k")),
+        "compact": jax.jit(
+            partial(fused_score_fn_flat_banded_compact, **common),
+            static_argnames=("n_keep", "gc_width", "b", "k")),
+        "band": jax.jit(
+            partial(fused_score_fn_flat_banded_sliced, **common),
+            static_argnames=("w_cap", "gc_width", "b", "k")),
+    }
 
 
 def to_numpy_global(arr) -> np.ndarray:
@@ -432,23 +471,41 @@ class JaxBackend:
         # cache already proved it holds this stream's executables (warmup
         # manifest), warmup skips the representative-batch EXECUTIONS
         self._compile_cache = compile_cache_path(sm_config)
+        shape_buckets.bind_manifest_dir(self._compile_cache)
         self.last_warmup_skipped = False
-        self.batch = max(1, sm_config.parallel.formula_batch)
+        # shape-bucket lattice (ISSUE 13, ops/buckets.py): the pad-to batch
+        # snaps DOWN to a lattice point (msm_basic slices at the same
+        # point), image rows snap UP with zero-row padding masked by the
+        # traced real-pixel count, and the resident peak arrays pad to a
+        # lattice capacity — so every dataset size maps into the closed
+        # signature set the census proves and the primer precompiles
+        self._buckets = shape_buckets.buckets_enabled(sm_config.parallel)
+        self.batch = shape_buckets.effective_batch(sm_config.parallel)
         img_cfg = ds_config.image_generation
         self.ppm = img_cfg.ppm
+        self._nrows_b = (shape_buckets.row_bucket(ds.nrows)
+                         if self._buckets else ds.nrows)
+        self._n_pix_b = self._nrows_b * ds.ncols
+        # traced real-pixel count: None when the lattice is off (the
+        # legacy unpadded program), a host scalar shipped per batch when on
+        self._n_real = np.int32(ds.n_pixels) if self._buckets else None
 
         self.int_scale = ds.intensity_quantization(self.ppm)[1]
         self.mz_chunk = max(0, sm_config.parallel.mz_chunk)
         common = dict(
-            nrows=ds.nrows,
+            nrows=self._nrows_b,
             ncols=ds.ncols,
             nlevels=img_cfg.nlevels,
             do_preprocessing=img_cfg.do_preprocessing,
             q=img_cfg.q,
         )
+        self._common = dict(common)
         if self.mz_chunk:
             # chunked path stays on the padded cube: its scratch bound
-            # (gc_width) is the point, and the cube shards cleanly
+            # (gc_width) is the point, and the cube shards cleanly.  It
+            # also stays OFF the pixel lattice — the cube's row layout is
+            # per-dataset anyway, so bucketing rows would not close its
+            # signature family (COMPILE_SURFACE declares it per-dataset)
             if restrict_table is not None:
                 logger.info(
                     "window-union restriction not applicable on the "
@@ -461,8 +518,12 @@ class JaxBackend:
                 "jax_tpu cube resident: %s int32 + %s f32 on %s",
                 mz_q.shape, int_cube.shape, self._mz_q.devices(),
             )
+            self._nrows_b = ds.nrows
+            self._n_pix_b = ds.n_pixels
+            self._n_real = None
             self._fn = jax.jit(
-                partial(fused_score_fn_chunked, **common),
+                partial(fused_score_fn_chunked, **{**common,
+                                                   "nrows": ds.nrows}),
                 static_argnames=("gc_width", "b", "k"),
             )
         else:
@@ -473,8 +534,9 @@ class JaxBackend:
             # few GB the device OOM is opaque, so fail early with guidance
             k_est = ds_config.isotope_generation.n_peaks
             # scratch cols = max(G+1, gc+2): bins live in [0, G=2BK]; chunk
-            # slices clamp+shift instead of spilling past G (imager_jax)
-            scratch = 4 * (ds.n_pixels + 1) * max(
+            # slices clamp+shift instead of spilling past G (imager_jax);
+            # rows are the BUCKETED pixel count — that is what allocates
+            scratch = 4 * (self._n_pix_b + 1) * max(
                 2 * self.batch * k_est + 1, 4098)
             if scratch > (8 << 30):
                 raise ValueError(
@@ -500,6 +562,24 @@ class JaxBackend:
                     mz_s.size, n_eff,
                     100.0 * (1 - n_eff / max(mz_s.size, 1)))
                 mz_s, px_s, in_s = mzk[0], pxk[0], ink[0]
+            if self._buckets:
+                # lattice-pad the resident arrays (ops/buckets.peak_bucket)
+                # with the SAME slot shape the 1024-multiple rounding
+                # already uses: m/z saturates to the MZ_PAD_Q sentinel
+                # (outside every window), pixel points at the overflow row,
+                # intensity 0 — bit-exact, and every dataset whose peak
+                # count shares the bucket shares the executable
+                n_pad = shape_buckets.peak_bucket(mz_s.size)
+                if n_pad > mz_s.size:
+                    from ..ops.quantize import MZ_PAD_Q
+
+                    tail = n_pad - mz_s.size
+                    mz_s = np.concatenate(
+                        [mz_s, np.full(tail, MZ_PAD_Q, mz_s.dtype)])
+                    px_s = np.concatenate(
+                        [px_s, np.full(tail, ds.n_pixels, px_s.dtype)])
+                    in_s = np.concatenate(
+                        [in_s, np.zeros(tail, in_s.dtype)])
             self._mz_host = mz_s
             self._px_s = jax.device_put(px_s, self.device)
             self._in_s = jax.device_put(in_s, self.device)
@@ -508,15 +588,10 @@ class JaxBackend:
                 mz_s.size, (px_s.nbytes + in_s.nbytes) / 1e6,
                 self._px_s.devices(),
             )
-            self._fn = jax.jit(
-                partial(fused_score_fn_flat_banded, **common),
-                static_argnames=("gc_width", "b", "k"))
-            self._fn_c = jax.jit(
-                partial(fused_score_fn_flat_banded_compact, **common),
-                static_argnames=("n_keep", "gc_width", "b", "k"))
-            self._fn_bs = jax.jit(
-                partial(fused_score_fn_flat_banded_sliced, **common),
-                static_argnames=("w_cap", "gc_width", "b", "k"))
+            fns = make_flat_jits(common)
+            self._fn = fns["plain"]
+            self._fn_c = fns["compact"]
+            self._fn_bs = fns["band"]
             # sticky static shapes: grow to the max seen so one executable
             # serves (almost) all batches instead of recompiling per batch
             self._gc_width = 0
@@ -543,8 +618,13 @@ class JaxBackend:
         padding batch.  Smaller tables compile (cached) executables at the
         new size; per-ion metrics are unchanged — batch size only sets
         padding and scratch shape.  Shrink-only: growing mid-stream would
-        recompile for no benefit."""
+        recompile for no benefit.  Under the lattice (ISSUE 13) the new
+        cap snaps DOWN to a lattice point, so an OOM-shrunk batch lands
+        on an executable the primer enumerated instead of minting a
+        one-off size."""
         new = max(1, int(batch))
+        if self._buckets:
+            new = shape_buckets.batch_bucket_down(new)
         if new < self.batch:
             logger.warning("jax_tpu backend: formula batch %d -> %d "
                            "(OOM backoff)", self.batch, new)
@@ -690,8 +770,7 @@ class JaxBackend:
                 np.int32(w_start), pos_b,
                 starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
             statics = dict(w_cap=cap, gc_width=gc_eff, b=b_eff, k=k)
-            return "band", args, statics
-        if variant == "compact":
+        elif variant == "compact":
             run_pos, run_delta, n_b, pos_b = runs
             self._grow_compact_capacity(runs)
             rp = np.full(self._r_pad, self._n_keep, np.int32)
@@ -703,10 +782,42 @@ class JaxBackend:
                 starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
             statics = dict(n_keep=self._n_keep, gc_width=gc_eff,
                            b=b_eff, k=k)
-            return "compact", args, statics
-        args = [jax.device_put(a) for a in (
-            pos, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
-        return "plain", args, dict(gc_width=gc_eff, b=b_eff, k=k)
+        else:
+            args = [jax.device_put(a) for a in (
+                pos, starts, r_lo_loc, r_hi_loc, inv, ints_p, nv_p)]
+            statics = dict(gc_width=gc_eff, b=b_eff, k=k)
+        if self._n_real is not None:
+            # the lattice's traced real-pixel scalar rides after n_valid
+            args.append(jax.device_put(self._n_real))
+            shape_buckets.record_spec(
+                self._bucket_spec(variant, args, statics))
+        return variant, args, statics
+
+    def _bucket_spec(self, variant: str, args, statics) -> dict:
+        """The BucketSpec of the executable this call shape resolves to
+        (ops/buckets.py): everything the AOT primer needs to rebuild the
+        byte-identical program — variant, metric geometry, statics, and
+        the argument shapes (read off the actual arrays, so the spec can
+        never drift from what dispatched)."""
+        pos_ix = _VARIANTS[variant][3]
+        rlo = args[pos_ix + 2]
+        return {
+            "kind": "flat", "variant": variant,
+            "nrows": int(self._common["nrows"]),
+            "ncols": int(self._common["ncols"]),
+            "nlevels": int(self._common["nlevels"]),
+            "do_preprocessing": bool(self._common["do_preprocessing"]),
+            "q": float(self._common["q"]),
+            "n_resident": int(self._px_s.shape[0]),
+            "b": int(statics["b"]), "k": int(statics["k"]),
+            "gc_width": int(statics["gc_width"]),
+            "n_keep": int(statics.get("n_keep", 0)),
+            "r_pad": (int(args[0].shape[0]) if variant == "compact" else 0),
+            "w_cap": int(statics.get("w_cap", 0)),
+            "g": int(args[pos_ix].shape[0]),
+            "c": int(rlo.shape[0]), "wc": int(rlo.shape[1]),
+            "devices": 1,
+        }
 
     def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
         """Async: enqueue one padded batch on device, return (device_out, n)."""
@@ -746,17 +857,22 @@ class JaxBackend:
         ext_statics = {kk: v for kk, v in statics.items()
                        if kk in ("n_keep", "w_cap", "gc_width")}
         ext_fn = jax.jit(partial(
-            ext_base, n_pixels=self.ds.n_pixels, **ext_statics))
-        # extraction args = everything before (theor_ints, n_valid); the
-        # trailing ``inv`` is the ION un-permutation consumed by the fused
-        # fn's metric output, not by extraction — probes keep the plan's
-        # ion-sorted order (side inputs below are permuted to match)
+            ext_base, n_pixels=self._n_pix_b, **ext_statics))
+        # extraction args = everything before (theor_ints, n_valid[,
+        # n_real]); the trailing ``inv`` is the ION un-permutation consumed
+        # by the fused fn's metric output, not by extraction — probes keep
+        # the plan's ion-sorted order (side inputs below permuted to match)
         ext_args = list(args[: n_ext - 1]) + [None]
         phases["extract"] = lambda: ext_fn(
             self._px_s, self._in_s, *ext_args)
-        imgs = phases["extract"]().reshape(
-            statics["b"], statics["k"], -1)[:, :, : self.ds.n_pixels]
-        nv_p, ints_p = args[-1], args[-2]
+        # the metric probes run on the PRODUCTION image block: the padded
+        # (b, k, P_bucket) lattice grid with the traced real-pixel count
+        # masking the centering, exactly like the fused graph
+        imgs = phases["extract"]().reshape(statics["b"], statics["k"], -1)
+        if self._n_real is not None:
+            n_real_d, nv_p, ints_p = args[-1], args[-2], args[-3]
+        else:
+            n_real_d, nv_p, ints_p = None, args[-1], args[-2]
         valid_d = jax.device_put(
             # smlint: host-sync-ok[probe-only fetch of the tiny n_valid vector; probes time phases, not dispatch]
             np.arange(statics["k"])[None, :] < np.asarray(nv_p)[:, None])
@@ -768,10 +884,10 @@ class JaxBackend:
         from ..ops.moments_pallas import batch_moments
 
         mom_fn = jax.jit(batch_moments)
-        phases["moments"] = lambda: mom_fn(imgs)
-        _sums, _normsq, _dots, _vmax, _nn = mom_fn(imgs)
+        phases["moments"] = lambda: mom_fn(imgs, n_real_d)
+        _sums, _normsq, _dots, _vmax, _nn = mom_fn(imgs, n_real_d)
         chaos_fn = jax.jit(partial(
-            measure_of_chaos_batch, nrows=self.ds.nrows, ncols=self.ds.ncols,
+            measure_of_chaos_batch, nrows=self._nrows_b, ncols=self.ds.ncols,
             nlevels=img_cfg.nlevels))
         phases["chaos"] = lambda: chaos_fn(
             imgs[:, 0, :], vmax=_vmax, n_notnull=_nn)
@@ -816,8 +932,11 @@ class JaxBackend:
                 jax.device_put(r_lo), jax.device_put(r_hi))
         else:
             if not hasattr(self, "_extract_fn"):
+                # bucketed extraction grid (lattice): the host-side slice
+                # below takes the exact-pixel prefix, so the export is
+                # bit-identical while the executable is shared per bucket
                 self._extract_fn = jax.jit(
-                    partial(extract_images_flat, n_pixels=self.ds.n_pixels))
+                    partial(extract_images_flat, n_pixels=self._n_pix_b))
             pos = flat_bound_ranks(self._mz_host, grid)
             imgs = self._extract_fn(
                 self._px_s, self._in_s, jax.device_put(pos),
@@ -916,9 +1035,16 @@ class JaxBackend:
 
     def _warmup_manifest_key(self, kinds) -> str | None:
         """Environment + stream identity for the warmup manifest: the
-        executable kinds, sticky capacities, dataset/device shapes, and the
+        executable kinds, sticky capacities, BUCKET ids, and the
         jax/backend versions (the same components that key the persistent
-        cache, minus the HLO itself)."""
+        cache, minus the HLO itself).
+
+        Keyed on bucket ids, not raw shapes (ISSUE 13 satellite): the
+        pixel geometry enters as (row_bucket, ncols) and the resident
+        count as its lattice capacity (``_mz_host`` is already padded to
+        it), so a cache primed — or warmed by ANY dataset size in the
+        bucket — is recognized as warm for every other size in it, with
+        no redundant representative-batch executions."""
         if self._compile_cache is None:
             return None
         import hashlib
@@ -927,7 +1053,8 @@ class JaxBackend:
         blob = repr((
             sorted(kinds),
             (self._gc_width, self._gc_tail, self._n_keep, self._r_pad),
-            (self.ds.n_pixels, int(self._mz_host.size), self.batch),
+            (self._nrows_b, self.ds.ncols, int(self._mz_host.size),
+             self.batch, bool(self._buckets)),
             (self.ds_config.image_generation.nlevels,
              self.ds_config.image_generation.do_preprocessing),
             (jax.__version__, dev.platform, str(dev.device_kind)),

@@ -53,10 +53,18 @@ def is_oom_error(exc: BaseException) -> bool:
 def shape_key(n_pixels: int, backend: str, device_indices=None) -> str:
     """Registry key for a (dataset-shape, mesh) combination: what the
     HBM footprint of a scoring batch actually depends on.  ``None``
-    device_indices = the config mesh over all local devices."""
+    device_indices = the config mesh over all local devices.
+
+    The pixel count keys on its LATTICE BUCKET (ISSUE 13,
+    ops/buckets.pixel_bucket): under the shape-bucket lattice every
+    dataset size in a bucket scores through the same executables at the
+    same scratch shapes, so a learned safe batch transfers across the
+    whole bucket instead of being re-discovered per size."""
+    from ..ops.buckets import pixel_bucket
+
     devs = ",".join(str(int(i)) for i in device_indices) \
         if device_indices else "*"
-    return f"px{int(n_pixels)}|{backend}|dev[{devs}]"
+    return f"pxb{pixel_bucket(int(n_pixels))}|{backend}|dev[{devs}]"
 
 
 class _GuardedRegistry:

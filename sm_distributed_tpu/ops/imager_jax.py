@@ -274,6 +274,8 @@ def prepare_flat_sharded_arrays(
     ppm: float,
     n_shards: int,
     pad_to_multiple: int = 1024,
+    p_loc: int | None = None,
+    slot_bucket=None,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Host-side flat layout per PIXEL SHARD: (mz_q (S, Nmax) int32 ascending
     per row, px_local (S, Nmax) int32, ints (S, Nmax) f32, p_loc).
@@ -285,16 +287,32 @@ def prepare_flat_sharded_arrays(
     the MAX spectrum length, catastrophic for ragged DESI data — per-shard
     bytes track the actual peak count.  The m/z rows stay host-side (bound
     ranks are host-computed); only pixel + intensity rows go to HBM.
-    """
-    p_pad = -(-ds.n_pixels // n_shards) * n_shards
-    p_loc = p_pad // n_shards
+
+    ``p_loc`` (ISSUE 13 lattice): an explicit per-shard pixel capacity
+    >= ceil(P/S) — the sharded backend passes a row-bucketed whole-row
+    capacity so every dataset size in the bucket shares the executable
+    (trailing shards may then be partially or wholly padding, exactly the
+    padded-slot shape the slice above already uses).  ``slot_bucket``
+    replaces the ``pad_to_multiple`` rounding of the peak-slot capacity
+    with the shared lattice (``ops/buckets.peak_bucket``)."""
+    if p_loc is None:
+        p_pad = -(-ds.n_pixels // n_shards) * n_shards
+        p_loc = p_pad // n_shards
+    elif p_loc * n_shards < ds.n_pixels:
+        raise ValueError(
+            f"p_loc={p_loc} x {n_shards} shards cannot hold "
+            f"{ds.n_pixels} pixels")
     mz_q = quantize_mz(ds.mzs_flat)
     ints_q, _scale = ds.intensity_quantization(ppm)
     lens = ds.row_lengths()
     pixel = np.repeat(np.arange(ds.n_pixels, dtype=np.int64), lens)
     shard = (pixel // p_loc).astype(np.int32)
     counts = np.bincount(shard, minlength=n_shards)
-    n_max = -(-max(int(counts.max()), 1) // pad_to_multiple) * pad_to_multiple
+    if slot_bucket is not None:
+        n_max = int(slot_bucket(max(int(counts.max()), 1)))
+    else:
+        n_max = -(-max(int(counts.max()), 1)
+                  // pad_to_multiple) * pad_to_multiple
     mz_s = np.full((n_shards, n_max), MZ_PAD_Q, dtype=np.int32)
     px_s = np.full((n_shards, n_max), p_loc, dtype=np.int32)
     in_s = np.zeros((n_shards, n_max), dtype=np.float32)

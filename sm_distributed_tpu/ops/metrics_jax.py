@@ -240,7 +240,7 @@ def hotspot_clip_batch(images: jnp.ndarray, q: float) -> jnp.ndarray:
 
 
 def batch_metrics(
-    images: jnp.ndarray,      # (N, K, n_pix) f32 — n_pix == nrows*ncols exactly
+    images: jnp.ndarray,      # (N, K, n_pix) f32 — n_pix == nrows*ncols
     theor_ints: jnp.ndarray,  # (N, K) f32
     n_valid: jnp.ndarray,     # (N,) i32
     nrows: int,
@@ -248,8 +248,23 @@ def batch_metrics(
     nlevels: int = 30,
     do_preprocessing: bool = False,
     q: float = 99.0,
+    n_real=None,              # traced i32 scalar: REAL pixels (lattice pad)
 ) -> jnp.ndarray:
-    """(N, 4) of (chaos, spatial, spectral, msm) for a formula batch."""
+    """(N, 4) of (chaos, spatial, spectral, msm) for a formula batch.
+
+    ``n_real`` (ISSUE 13 shape-bucket lattice): when ``nrows`` is the
+    ROW-BUCKETED grid (ops/buckets.row_bucket) the trailing rows are zero
+    padding and ``n_real`` carries the dataset's true pixel count as a
+    TRACED scalar.  Zero pads are exactly invariant for every metric op
+    except the correlation's mean over pixels — which divides by
+    ``n_real`` with the centered block masked back to zero past it
+    (moments_pallas.batch_moments) — and the hotspot percentile, whose
+    sorted-index arithmetic is pad-count invariant by construction (the
+    positives occupy the top ``m`` slots wherever the zeros sit).  Chaos
+    runs on the padded grid unmasked: zero pixels are below every
+    threshold, so component counts, ``vmax`` and ``n_notnull`` are exact
+    integers either way.  Result: metrics are bit-identical to unpadded
+    scoring while every dataset size in a bucket shares ONE executable."""
     k = images.shape[1]
     valid = jnp.arange(k)[None, :] < n_valid[:, None]
     images = jnp.where(valid[:, :, None], images, 0.0)
@@ -262,7 +277,8 @@ def batch_metrics(
     # DESI batch against ~3 ms fused
     from .moments_pallas import batch_moments
 
-    sums, normsq, dots, vmax, n_notnull = batch_moments(images)
+    sums, normsq, dots, vmax, n_notnull = batch_moments(images,
+                                                        n_real=n_real)
     chaos = measure_of_chaos_batch(
         images[:, 0, :], nrows, ncols, nlevels,
         vmax=vmax, n_notnull=n_notnull)

@@ -38,8 +38,12 @@ from ..analysis.surface import compile_surface
 COMPILE_SURFACE = compile_surface(__name__, {
     "batch_moments_pallas":
         "statics=interpret; buckets=one executable per padded (N, K, P) "
-        "batch shape — N/K ride the formula_batch padding, P is "
-        "per-dataset static",
+        "batch shape — N/K ride the formula_batch padding, P is the "
+        "row-bucketed pixel lattice point (ops/buckets.row_bucket)",
+    "batch_moments_pallas_masked":
+        "statics=interpret; buckets=same (N, K, P) lattice as the unmasked "
+        "kernel; the real-pixel count is a TRACED operand, so every "
+        "dataset size in a pixel bucket shares one executable (ISSUE 13)",
 })
 
 # VMEM budget for one ion's (K, P) row block, in f32 cells.  The block is
@@ -95,6 +99,78 @@ def _moments_kernel(img_ref, out_ref, *, k: int, p: int):
     out_ref[0] = out
 
 
+def _moments_kernel_masked(n_ref, img_ref, out_ref, *, k: int, p: int):
+    """The masked sibling of ``_moments_kernel`` (ISSUE 13 lattice): the
+    trailing ``p - n_real`` pixels are zero padding from the row bucket.
+    Sums/max/positive-count are exactly invariant to zero pads; only the
+    centering changes — the mean divides by the TRACED real count and the
+    centered tile is masked back to zero past it, mirroring the masked
+    XLA fallback (``batch_moments_jnp``) op for op."""
+    nt = p // _TILE if p % _TILE == 0 else 1
+    tw = _TILE if p % _TILE == 0 else p
+    n_real = n_ref[0, 0]                                 # i32 scalar
+
+    def pass1(i, acc):
+        sums, vmax, nn = acc
+        t = img_ref[0, :, pl.dslice(i * tw, tw)]        # (K, tw) f32
+        sums = sums + jnp.sum(t, axis=1, keepdims=True)
+        r0 = t[0:1]
+        vmax = jnp.maximum(vmax, jnp.max(r0, axis=1, keepdims=True))
+        nn = nn + jnp.sum((r0 > 0.0).astype(jnp.float32), axis=1,
+                          keepdims=True)
+        return sums, vmax, nn
+
+    sums0 = jnp.zeros((k, 1), jnp.float32)
+    vmax0 = jnp.full((1, 1), -jnp.inf, jnp.float32)
+    nn0 = jnp.zeros((1, 1), jnp.float32)
+    sums, vmax, nn = jax.lax.fori_loop(0, nt, pass1, (sums0, vmax0, nn0))
+    mean = sums / n_real.astype(jnp.float32)             # (K, 1)
+
+    def pass2(i, acc):
+        normsq, dots = acc
+        t = img_ref[0, :, pl.dslice(i * tw, tw)]
+        cols = jax.lax.broadcasted_iota(jnp.int32, (k, tw), 1) + i * tw
+        c = jnp.where(cols < n_real, t - mean, 0.0)      # (K, tw) centered
+        c0 = c[0:1]                                      # principal row
+        normsq = normsq + jnp.sum(c * c, axis=1, keepdims=True)
+        dots = dots + jnp.sum(c0 * c, axis=1, keepdims=True)
+        return normsq, dots
+
+    z = jnp.zeros((k, 1), jnp.float32)
+    normsq, dots = jax.lax.fori_loop(0, nt, pass2, (z, z))
+
+    out = jnp.concatenate(
+        [sums, normsq, dots,
+         jnp.broadcast_to(vmax, (k, 1)), jnp.broadcast_to(nn, (k, 1))],
+        axis=1)                                          # (K, 5)
+    out_ref[0] = out
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def batch_moments_pallas_masked(images: jnp.ndarray, n_real,
+                                interpret: bool = False):
+    """Masked-moments Pallas route: like ``batch_moments_pallas`` but the
+    real-pixel count is a traced (1, 1) i32 operand, so every dataset size
+    inside one pixel bucket shares this executable (ISSUE 13)."""
+    n, k, p = images.shape
+    n_arr = jnp.asarray(n_real, jnp.int32).reshape(1, 1)
+    out = pl.pallas_call(
+        partial(_moments_kernel_masked, k=k, p=p),
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, 1), lambda i: (0, 0)),
+                  pl.BlockSpec((1, k, p), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((1, k, 5), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, k, 5), jnp.float32),
+        interpret=interpret,
+    )(n_arr, images)
+    sums = out[:, :, 0]
+    normsq = out[:, :, 1]
+    dots = out[:, :, 2]
+    vmax = out[:, 0, 3]
+    nn = out[:, 0, 4]
+    return sums, normsq, dots, vmax, nn
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def batch_moments_pallas(images: jnp.ndarray, interpret: bool = False):
     """(sums (N,K), normsq (N,K), dots (N,K), vmax (N,), n_notnull (N,))
@@ -116,12 +192,28 @@ def batch_moments_pallas(images: jnp.ndarray, interpret: bool = False):
     return sums, normsq, dots, vmax, nn
 
 
-def batch_moments_jnp(images: jnp.ndarray):
+def batch_moments_jnp(images: jnp.ndarray, n_real=None):
     """XLA fallback with identical semantics (non-TPU backends, or image
-    rows past the VMEM budget)."""
+    rows past the VMEM budget).
+
+    ``n_real`` (ISSUE 13 shape-bucket lattice): traced i32 scalar count of
+    REAL pixels when the trailing pixels are lattice padding (whole zero
+    rows appended by ``ops/buckets.row_bucket``).  Padded zeros are exact
+    no-ops for sums/norms/dots/max/count, but the correlation's mean
+    divides by the PIXEL COUNT — so the mean takes the real count and the
+    centered block is masked back to zero on pad pixels.  With
+    ``n_real == P`` (or None) the arithmetic is the unpadded sequence
+    bit-for-bit: the mask keeps every value and the division sees the
+    same operands."""
     sums = images.sum(axis=-1)
-    mean = sums[..., None] / np.float32(images.shape[-1])
-    cent = images - mean
+    if n_real is None:
+        mean = sums[..., None] / np.float32(images.shape[-1])
+        cent = images - mean
+    else:
+        mean = sums[..., None] / n_real.astype(jnp.float32)
+        real = (jnp.arange(images.shape[-1], dtype=jnp.int32)
+                < n_real)[None, None, :]
+        cent = jnp.where(real, images - mean, 0.0)
     normsq = jnp.sum(cent * cent, axis=-1)
     dots = jnp.einsum("np,nkp->nk", cent[:, 0, :], cent)
     principal = images[:, 0, :]
@@ -130,9 +222,14 @@ def batch_moments_jnp(images: jnp.ndarray):
     return sums, normsq, dots, vmax, nn
 
 
-def batch_moments(images: jnp.ndarray):
-    """Route to the Pallas kernel on TPU when the block shape fits."""
+def batch_moments(images: jnp.ndarray, n_real=None):
+    """Route to a Pallas kernel on TPU when the block shape fits.
+    ``n_real`` (lattice-padded pixels, ISSUE 13) selects the masked
+    kernel — the real-pixel count rides as a traced operand so the
+    executable is shared across every dataset size in the bucket."""
     n, k, p = images.shape
     if jax.default_backend() == "tpu" and moments_fit(k, p):
-        return batch_moments_pallas(images)
-    return batch_moments_jnp(images)
+        if n_real is None:
+            return batch_moments_pallas(images)
+        return batch_moments_pallas_masked(images, n_real)
+    return batch_moments_jnp(images, n_real=n_real)

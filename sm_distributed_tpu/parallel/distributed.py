@@ -52,7 +52,12 @@ def enable_compile_cache(sm_config) -> None:
     import jax
 
     jax.config.update("jax_compilation_cache_dir", str(path))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # persist EVERY compile (ISSUE 13): the old 1.0 s floor meant fast
+    # compiles were never written — which is exactly what made a "primed"
+    # cache unreliable (the warmup manifest's entries==0 special case
+    # exists because of it).  Entries are small; the disk-budget governor
+    # and retention GC bound the directory like any other cache.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
 def resolve_distributed_settings(cfg: ParallelConfig) -> tuple[str, int, int]:

@@ -40,6 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..analysis.surface import compile_surface
 from ..io.dataset import SpectralDataset
+from ..ops import buckets as shape_buckets
 from ..ops.imager_jax import (
     BAND_WINDOWS as _BAND_WINDOWS,
 )
@@ -69,7 +70,10 @@ COMPILE_SURFACE = compile_surface(__name__, {
     "step":
         "statics=closure(gc_width,n_keep,w_cap); buckets=one executable per "
         "(gc_width, n_keep, w_cap) triple — sticky _grow_static_shapes "
-        "fixpoint + band_bucket ladder bound the triple set per stream; the "
+        "fixpoint + band_bucket ladder bound the triple set per stream; "
+        "per-shard pixel rows and resident peak slots snap to the "
+        "ops/buckets lattice with a traced real-pixel count (ISSUE 13), "
+        "so dataset sizes sharing a bucket share the executable; the "
         "extract_ion_images step is a second, statics-free export program",
     "sharded":
         "statics=closure(gc_width,n_keep,w_cap); buckets=jit of the "
@@ -104,7 +108,7 @@ def build_sharded_score_factory(
     n_pix = mesh.shape[PIXELS_AXIS]
 
     def step(px_s, in_s, pos, starts, r_lo_loc, r_hi_loc, inv,
-             theor_ints, n_valid, run_pos, run_delta, n_b,
+             theor_ints, n_valid, run_pos, run_delta, n_b, n_real,
              *, gc_width, n_keep, w_cap):
         # Per-device blocks: px_s/in_s (1, Nmax); pos (1, G_loc); plan
         # (C, Wc)/(C,)/(W_loc,); theor (B_loc, K); n_valid (B_loc,);
@@ -141,9 +145,12 @@ def build_sharded_score_factory(
         ti = theor_ints.reshape(n_pix, b // n_pix, k)
         nv = n_valid.reshape(n_pix, b // n_pix)
         my = jax.lax.axis_index(PIXELS_AXIS)
+        # ``nrows`` is the (possibly row-bucketed) metric grid; ``n_real``
+        # carries the dataset's true pixel count as a traced scalar so the
+        # masked centering stays bit-identical on lattice padding
         out_mine = batch_metrics(
             imgs_mine, ti[my], nv[my], nrows, ncols, nlevels,
-            do_preprocessing=do_preprocessing, q=q,
+            do_preprocessing=do_preprocessing, q=q, n_real=n_real[0],
         )                                                # (B_loc/n_pix, 4)
         # reassemble the formula shard's rows (ion chunks are in pixel-shard
         # order, matching the original ion order)
@@ -168,6 +175,7 @@ def build_sharded_score_factory(
                 P(PIXELS_AXIS, FORMULAS_AXIS),    # run_pos (S, F*R_pad)
                 P(PIXELS_AXIS, FORMULAS_AXIS),    # run_delta (S, F*R_pad)
                 P(PIXELS_AXIS, FORMULAS_AXIS),    # n_b (S, F)
+                P(None),                          # n_real (1,) replicated
             ),
             out_specs=P(FORMULAS_AXIS, None),
             # The output IS replicated over "pixels" (tiled all_gather of the
@@ -207,10 +215,16 @@ class ShardedJaxBackend:
         self.mesh = mesh if mesh is not None else make_mesh(sm_config.parallel)
         n_pix_shards = self.mesh.shape[PIXELS_AXIS]
         n_form_shards = self.mesh.shape[FORMULAS_AXIS]
+        # shape-bucket lattice (ISSUE 13, ops/buckets.py): the pad-to
+        # batch snaps to a lattice point first, then to the mesh granule
+        self._buckets = shape_buckets.buckets_enabled(sm_config.parallel)
+        from .distributed import compile_cache_path
+
+        shape_buckets.bind_manifest_dir(compile_cache_path(sm_config))
         # Static batch padded so each formula shard's block further splits
         # evenly across the pixel shards (the all_to_all ion sub-batches).
         self.batch = _round_up(
-            max(1, sm_config.parallel.formula_batch),
+            shape_buckets.effective_batch(sm_config.parallel),
             n_form_shards * n_pix_shards)
         img_cfg = ds_config.image_generation
         self.ppm = img_cfg.ppm
@@ -238,8 +252,29 @@ class ShardedJaxBackend:
                 f" x {k_est} peaks); reduce parallel.formula_batch, grow the"
                 " pixels mesh axis, or add formula shards")
 
-        mz_s, px_s, in_s, self._p_loc = prepare_flat_sharded_arrays(
-            ds, self.ppm, n_pix_shards)
+        if self._buckets:
+            # per-shard pixel capacity = lattice WHOLE rows (each shard
+            # owns complete image rows, so the concatenated padding stays
+            # a contiguous tail) and peak slots on the shared lattice.
+            # The metric grid is the SAME row bucket the single-device
+            # path uses — the step slices its concat down to it — so
+            # sharded metrics reduce over the identical padded length and
+            # stay BIT-EQUAL to the single-device fused graph, while every
+            # dataset size in the bucket shares the step executable
+            nrows_b = shape_buckets.row_bucket(ds.nrows)
+            r_loc_b = shape_buckets.pow2ish(
+                -(-nrows_b // n_pix_shards), 1)
+            mz_s, px_s, in_s, self._p_loc = prepare_flat_sharded_arrays(
+                ds, self.ppm, n_pix_shards, p_loc=r_loc_b * ds.ncols,
+                slot_bucket=shape_buckets.peak_bucket)
+            self._nrows_metric = nrows_b
+        else:
+            mz_s, px_s, in_s, self._p_loc = prepare_flat_sharded_arrays(
+                ds, self.ppm, n_pix_shards)
+            self._nrows_metric = ds.nrows
+        # the dataset's true pixel count, shipped replicated to every
+        # device for the masked metric centering (lattice, ISSUE 13)
+        self._n_real_host = np.full(1, ds.n_pixels, np.int32)
         if restrict_table is not None:
             mz_s, px_s, in_s = self._restrict_shards(
                 mz_s, px_s, in_s, restrict_table)
@@ -256,6 +291,7 @@ class ShardedJaxBackend:
             self.mesh, P(PIXELS_AXIS, FORMULAS_AXIS))
         self._form_sharding = NamedSharding(self.mesh, P(FORMULAS_AXIS, None))
         self._nv_sharding = NamedSharding(self.mesh, P(FORMULAS_AXIS))
+        self._rep_sharding = NamedSharding(self.mesh, P(None))
         self._n_form_shards = n_form_shards
         logger.info(
             "jax_tpu sharded flat peaks resident: %s over mesh %s "
@@ -266,7 +302,7 @@ class ShardedJaxBackend:
         self._make_fn = build_sharded_score_factory(
             self.mesh,
             p_loc=self._p_loc,
-            nrows=ds.nrows,
+            nrows=self._nrows_metric,
             ncols=ds.ncols,
             nlevels=img_cfg.nlevels,
             do_preprocessing=img_cfg.do_preprocessing,
@@ -497,10 +533,34 @@ class ShardedJaxBackend:
         rp_d = jax.device_put(rp, self._pos_sharding)
         rd_d = jax.device_put(rd, self._pos_sharding)
         nb_d = jax.device_put(nb, self._pos_sharding)
+        nr_d = jax.device_put(self._n_real_host, self._rep_sharding)
+        if self._buckets:
+            shape_buckets.record_spec(self._sharded_spec(variant, key))
         out = self._fns[key](self._px_s, self._in_s, pos_d, starts_d,
                              rlo_d, rhi_d, inv_d, ints_d, nv_d,
-                             rp_d, rd_d, nb_d)
+                             rp_d, rd_d, nb_d, nr_d)
         return out, table.n_ions
+
+    def _sharded_spec(self, variant: str, key: tuple) -> dict:
+        """BucketSpec of one sharded step executable (ops/buckets.py) —
+        recorded for the /debug/compile lattice view; the AOT primer
+        rebuilds it only on hosts whose visible device count matches the
+        mesh (the executable is mesh-shaped)."""
+        gc, n_keep, w_cap = key
+        img = self.ds_config.image_generation
+        return {
+            "kind": "sharded", "variant": variant,
+            "nrows": int(self._nrows_metric), "ncols": int(self.ds.ncols),
+            "nlevels": int(img.nlevels),
+            "do_preprocessing": bool(img.do_preprocessing),
+            "q": float(img.q),
+            "n_resident": int(self._px_s.shape[1]),
+            "b": int(self.batch), "k": 0,
+            "gc_width": int(gc), "n_keep": int(n_keep),
+            "r_pad": int(self._r_pad), "w_cap": int(w_cap),
+            "g": 0, "c": 0, "wc": 0,
+            "devices": int(self.mesh.size),
+        }
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         from ..models.msm_jax import to_numpy_global

@@ -40,7 +40,11 @@ RabbitMQ's management UI):
   preflight denials, retention-GC stats, and the HBM-OOM safe-batch
   registry.  Submits shed by a disk-budget breach return **507** with a
   ``Retry-After`` header (the last step of the traces → cache → submits
-  degrade order).
+  degrade order);
+- ``GET /debug/compile``  the cold-start lattice view (ISSUE 13): every
+  recorded shape bucket with primed/missing status (``service/primer.py``)
+  plus the runtime retrace census per attributed call site
+  (``analysis/retrace.py``).
 
 ``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
 handler is read-only except ``/submit`` (appends to ``pending/``) and
@@ -160,6 +164,9 @@ class AdminAPI:
                             200, tracing.flight_recorder.recent(n))
                     elif url.path == "/debug/resources":
                         status, body = api._resources()
+                        self._reply_json(status, body)
+                    elif url.path == "/debug/compile":
+                        status, body = api._compile()
                         self._reply_json(status, body)
                     elif url.path == "/debug/timeseries":
                         q = parse_qs(url.query)
@@ -363,6 +370,31 @@ class AdminAPI:
             "n": len(samples),
             "samples": samples,
         }
+
+    def _compile(self) -> tuple[int, dict]:
+        """``GET /debug/compile`` (ISSUE 13): the cold-start lattice view —
+        every recorded shape bucket with its primed/missing status
+        (service/primer.py), plus the runtime retrace census (observed
+        compile events/signatures per attributed site, analysis/retrace.py)
+        so primed-but-never-hit and hit-but-never-primed buckets are both
+        visible from one endpoint."""
+        from ..analysis import retrace
+
+        primer = getattr(self.service, "primer", None)
+        snap = retrace.snapshot()
+        body = {
+            "primer": (primer.snapshot() if primer is not None else None),
+            "retrace": {
+                "events_total": snap["events_total"],
+                "signatures_total": snap["signatures_total"],
+                "sites": {
+                    site: {"events": ent["events"],
+                           "signatures": len(ent["signatures"])}
+                    for site, ent in snap["sites"].items()
+                },
+            },
+        }
+        return 200, body
 
     def _resources(self) -> tuple[int, dict]:
         """``GET /debug/resources`` — the resource governor's snapshot

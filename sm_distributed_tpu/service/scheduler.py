@@ -164,6 +164,11 @@ class JobRecord:
     cancel_requested: str = ""     # "" | "user" (DELETE /jobs/<id>)
     error: str = ""
     trace_id: str = ""             # end-to-end trace (GET /jobs/<id>/trace)
+    # streamed first results (ISSUE 13): the latest provisional-annotation
+    # summary from the running search ({provisional, group, n_scored,
+    # n_ions, annotations, fdr_10pct, top}); {} until the first
+    # FDR-rankable group lands
+    partial: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -176,6 +181,7 @@ class JobRecord:
             "deadline_at": self.deadline_at,
             "cancel_requested": self.cancel_requested, "error": self.error,
             "trace_id": self.trace_id,
+            "partial": dict(self.partial),
         }
 
 
@@ -202,6 +208,11 @@ class JobContext:
     # span): callbacks attach it so every phase/batch span lands in the
     # job's trace; None for legacy callers
     trace: object = field(repr=False, default=None)
+    # streamed first results (ISSUE 13): callbacks call this with the
+    # provisional-annotation payload when the first FDR-rankable group
+    # lands — it updates the job record's ``partial`` field served by
+    # GET /jobs.  None for legacy callers.
+    set_partial: object = field(repr=False, default=None)
 
 
 def _callback_takes_ctx(fn) -> bool:
@@ -444,6 +455,13 @@ class JobScheduler:
             "terminal": self._terminal_count,
             "stopping": self._stop.is_set(),
         }
+
+    def _set_partial(self, rec: JobRecord, payload: dict) -> None:
+        """Streamed first results (ISSUE 13): the running search published
+        a provisional-annotation summary — surface it on the job record
+        so GET /jobs shows rankable results while later batches run."""
+        with self._records_lock:
+            rec.partial = dict(payload or {})
 
     def _note_terminal(self, rec: JobRecord) -> None:
         with self._records_lock:
@@ -844,7 +862,9 @@ class JobScheduler:
                              trace=attempt_trace,
                              fence=(None if claim_lease is None else
                                     (lambda _l=claim_lease:
-                                     self.leases.check(_l))))
+                                     self.leases.check(_l))),
+                             set_partial=(lambda p, _r=rec:
+                                          self._set_partial(_r, p)))
             attempt = _Attempt(self.callback, msg, ctx, self._cb_takes_ctx)
             with self._records_lock:
                 self._live[msg_id] = (token, attempt)

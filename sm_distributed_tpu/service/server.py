@@ -112,6 +112,16 @@ class AnnotationService:
             queue_dir, callback, config=cfg, queue=queue, metrics=self.metrics,
             admission=self.admission, trace_dir=self.trace_dir, slo=self.slo,
             device_pool=self.device_pool, resources=self.resources)
+        # ahead-of-time cache primer (ISSUE 13, service/primer.py): when
+        # the spool sits idle, AOT-compile the recorded shape-bucket
+        # lattice into the persistent XLA cache so a cold submit loads
+        # executables instead of compiling.  Constructed even when
+        # disabled — GET /debug/compile serves its primed-vs-missing view
+        # either way; only the idle thread is gated on the knob.
+        from .primer import CachePrimer
+
+        self.primer = CachePrimer(
+            self.sm_config, busy=self._primer_busy, metrics=self.metrics)
         # replica-scoped spool re-adoption + the registry-backed peer view:
         # each replica tracks its own shards and folds the peers' gossiped
         # summaries into its quota/shed decisions (GET /peers serves both)
@@ -187,6 +197,15 @@ class AnnotationService:
         root = self.queue_dir / self.queue
         return {s: len(list(root.glob(f"{s}/*.json"))) for s in _STATES}
 
+    def _primer_busy(self) -> bool:
+        """Real work in flight?  The primer only runs while this is False
+        (and re-checks between specs), so priming never delays a job."""
+        if self.scheduler.live_claims() > 0:
+            return True
+        root = self.queue_dir / self.queue
+        return any(True for _ in root.glob("pending/*.json")) or \
+            any(True for _ in root.glob("running/*.json"))
+
     def stopping(self) -> bool:
         """True once shutdown began — /submit sheds with 503 from here on."""
         return self._stop_requested.is_set()
@@ -205,6 +224,8 @@ class AnnotationService:
         if self.sm_config.telemetry.enabled:
             self.telemetry.start()
         self.scheduler.start()
+        if self.sm_config.service.prime.enabled:
+            self.primer.start()
         if self.api is not None:
             self.api.start()
         logger.info("service: up (queue=%s)", self.queue_dir / self.queue)
@@ -228,6 +249,7 @@ class AnnotationService:
                          self.sm_config.service.drain_timeout_s) + 10.0)
             return True
         logger.info("service: shutdown requested — draining")
+        self.primer.stop()
         ok = self.scheduler.shutdown(timeout_s)
         if self.api is not None:
             self.api.stop()

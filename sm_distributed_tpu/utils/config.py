@@ -172,6 +172,12 @@ class ParallelConfig:
     # backends stay resident across queue messages (LRU; 0 disables) —
     # engine/residency.py
     resident_datasets: int = 2
+    # shape-bucket lattice (ISSUE 13, ops/buckets.py): "auto"/"on" snap
+    # dataset-dependent shapes (pixel rows, resident peak slots, pad-to
+    # batch) to the canonical power-of-two-ish lattice so every dataset
+    # size maps into a closed, primeable signature set; "off" keeps exact
+    # legacy shapes (one executable family per dataset size)
+    shape_buckets: str = "auto"
 
 
 @dataclass(frozen=True)
@@ -264,6 +270,35 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class PrimeConfig:
+    """Ahead-of-time XLA cache priming (ISSUE 13, service/primer.py,
+    docs/PERF.md "Cold start"): a scheduler-idle background thread AOT-
+    compiles the recorded (config, bucket, lease-shape) lattice into the
+    persistent compilation cache, so a cold submit loads executables from
+    disk instead of paying the compile.  ``GET /debug/compile`` reports
+    primed vs missing buckets; ``scripts/prime_cache.py`` is the offline
+    equivalent."""
+
+    enabled: bool = False                # start the idle primer thread
+    idle_after_s: float = 5.0            # spool must be idle this long
+                                         # before a prime cycle starts
+    interval_s: float = 30.0             # rescan cadence for new bucket
+                                         # specs once everything known is
+                                         # primed
+    max_specs_per_cycle: int = 0         # compile at most N specs per
+                                         # idle cycle (0 = no cap); the
+                                         # primer re-checks idleness
+                                         # between specs either way
+
+    def __post_init__(self):
+        if self.idle_after_s < 0 or self.interval_s <= 0:
+            raise ValueError("prime: idle_after_s must be >= 0 and "
+                             "interval_s positive")
+        if self.max_specs_per_cycle < 0:
+            raise ValueError("prime: max_specs_per_cycle must be >= 0")
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """Annotation-service knobs (scheduler + failure policy + admin API) —
     the serving-side analog of the reference's rabbitmq/daemon settings.
@@ -349,6 +384,7 @@ class ServiceConfig:
                                          # parallel.formula_batch)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    prime: PrimeConfig = field(default_factory=PrimeConfig)
 
     def __post_init__(self):
         if self.workers <= 0 or self.max_attempts <= 0:
@@ -580,4 +616,5 @@ _DATACLASS_FIELDS = {
     ("SMConfig", "logs"): LogsConfig,
     ("ServiceConfig", "admission"): AdmissionConfig,
     ("ServiceConfig", "fleet"): FleetConfig,
+    ("ServiceConfig", "prime"): PrimeConfig,
 }

@@ -29,6 +29,7 @@ def test_report_schema_and_values():
         "numpy_floor_n_ions", "floor_procs",
         "numpy_floor_multiproc_ions_per_s", "vs_baseline_multiproc",
         "compile_s", "warmup_retried", "warmup_skipped",
+        "cold_compile_s", "first_annotation_cold_s",
         "hbm_peak_bytes", "device_kind",
         "xla_cache_entries_before",
         "n_ions", "n_pixels", "pixels_per_s", "isocalc_s",
@@ -58,6 +59,16 @@ def test_report_schema_and_values():
     assert out["isocalc_cold_s"] is None
     assert out["isocalc_workers"] is None
     assert out["patterns_per_s"] is None
+    # cleared-cache cold-start pins (ISSUE 13): None under --skip-cold,
+    # rounded pass-throughs when measured
+    assert out["cold_compile_s"] is None
+    assert out["first_annotation_cold_s"] is None
+    prep, floor, jaxr = _fake_inputs()
+    out2 = report(prep, floor, jaxr,
+                  cold={"cold_compile_s": 31.456,
+                        "first_annotation_cold_s": 4.321})
+    assert out2["cold_compile_s"] == 31.46
+    assert out2["first_annotation_cold_s"] == 4.32
     # HBM pinning (ISSUE 6 satellite): null when the platform exposes no
     # memory stats, passed through when measure_jax captured them
     assert out["hbm_peak_bytes"] is None

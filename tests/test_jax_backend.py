@@ -584,17 +584,21 @@ def test_tail_batch_executable_matches(fixture_ds):
     sm = SMConfig.from_dict(
         {"backend": "jax_tpu", "parallel": {"formula_batch": 300}})
     backend = JaxBackend(ds, ds_config, sm)
-    # default threshold routing
+    # the shape-bucket lattice snaps the pad-to batch DOWN to a lattice
+    # point (ops/buckets.batch_bucket_down: 300 -> 256), so an arbitrary
+    # configured size cannot mint a one-off executable
+    assert backend.batch == 256
+    # default threshold routing (batch == tail width -> one executable)
     assert backend._batch_for(8) == 256
-    assert backend._batch_for(2048) == 300
+    assert backend._batch_for(2048) == 256
     # a MIXED-size stream through both executables: shrink the tail
-    # threshold so the head (32 ions) takes the full-size (b=300) variant
-    # while the tail (8 ions) takes the small one — this exercises the
-    # b_eff plumbing on both, in one warmed backend
+    # threshold so the head takes the full-size (b=256) variant while the
+    # tail (8 ions) takes the small one — this exercises the b_eff
+    # plumbing on both, in one warmed backend
     backend._TAIL_BATCH = 8
     head = _slice_table(table, 0, table.n_ions - 8)
     tail = _slice_table(table, table.n_ions - 8, table.n_ions)
-    assert backend._batch_for(head.n_ions) == 300
+    assert backend._batch_for(head.n_ions) == 256
     assert backend._batch_for(tail.n_ions) == 8
     outs = backend.score_batches([head, tail])
     np_b = NumpyBackend(ds, ds_config)
