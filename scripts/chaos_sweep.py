@@ -219,6 +219,33 @@ SCENARIOS: list[Scenario] = [
     Scenario("trace.append", "consume", "trace.append=raise:OSError@1",
              "trace-file write fault (ENOSPC family) is swallowed — "
              "observability degrades, the job completes golden"),
+    # --- device-fault survival seams (ISSUE 14) ------------------------
+    # the exception CLASS at the chip-fault seam selects the taxonomy
+    # (models/faults.py): RuntimeError = sticky (chip quarantined out of
+    # the 2-chip simulated pool; the retry re-leases the survivor),
+    # ConnectionError = transient (retry same chip, no quarantine)
+    Scenario("backend.chip_fault", "consume",
+             "backend.chip_fault=raise:RuntimeError@1",
+             "sticky chip fault mid-job: the chip is quarantined, the "
+             "retry re-leases the surviving chip and converges to golden",
+             golden_sm=True,
+             sm={"backend": "jax_tpu",
+                 "service": {"device_pool_size": 2}}),
+    Scenario("backend.chip_fault", "consume",
+             "backend.chip_fault=raise:ConnectionError@1",
+             "transient chip fault (collective-timeout class): retry on "
+             "the SAME chip after backoff — no quarantine, no breaker "
+             "count, golden results",
+             tag="transient", golden_sm=True,
+             sm={"backend": "jax_tpu",
+                 "service": {"device_pool_size": 2}}),
+    Scenario("device.probe", "consume", "device.probe=raise:OSError@1",
+             "fault during the lease-time health probe: the probed chip "
+             "is quarantined BEFORE the job touches it; the grant retries "
+             "on the survivor and the job completes golden",
+             golden_sm=True,
+             sm={"backend": "jax_tpu",
+                 "service": {"device_pool_size": 2}}),
     # --- elastic-fleet drain seams (ISSUE 11) --------------------------
     # SM_CHAOS_DRAIN=1 makes the consume subprocess request a drain on
     # ITSELF once a claim exists, driving the zero-loss drain protocol

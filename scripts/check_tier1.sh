@@ -119,6 +119,17 @@ if ! env JAX_PLATFORMS=cpu python scripts/multichip_smoke.py; then
     exit 1
 fi
 
+# device-fault survival gate (ISSUE 14): a 4-chip sharded job on the
+# virtual mesh survives a sticky chip death mid-job — the chip is
+# probe-attributed and quarantined, the retry resumes from checkpoint on
+# the 3 surviving chips with BIT-IDENTICAL stored annotations, the
+# quarantine is visible on /debug/devices + /metrics, no later lease
+# includes the fenced chip, and a passing re-probe readmits it
+if ! env JAX_PLATFORMS=cpu python scripts/device_chaos.py --smoke; then
+    echo "check_tier1: FAIL — device-fault survival gate failed" >&2
+    exit 1
+fi
+
 # cold-start smoke gate (ISSUE 13): a cleared-persistent-cache 64x64
 # submit through the real service must deliver its first FDR-rankable
 # annotations in < 5 s (proven via /slo attainment), with the trace

@@ -1,0 +1,316 @@
+#!/usr/bin/env python
+"""Device-fault survival gate (ISSUE 14; wired into scripts/check_tier1.sh).
+
+Proves the chip-level fault-survival layer end to end on a virtual 8-chip
+CPU mesh, through the REAL service stack (spool, scheduler, device pool +
+health tracker, SearchJob, tracing), with a 4-chip pool:
+
+1. **golden**: a ``devices: 4`` submit scores through the pjit-sharded
+   4-chip mesh fault-free — its stored annotations are the golden report;
+2. **sticky chip death mid-job**: chip 3 is marked bad through the
+   probe's chaos seam (``HealthTracker.simulate_bad`` — the CPU CI analog
+   of dead hardware) and a sticky fault is injected at the second scoring
+   group (``backend.chip_fault`` failpoint).  The health tracker
+   probe-attributes the fault, quarantines chip 3, and the scheduler's
+   retry re-leases the three survivors: the job resumes from its group-0
+   checkpoint on the SHRUNKEN 3-chip mesh and its stored annotations are
+   **bit-identical** to the 4-chip golden (the shape-bucket lattice +
+   mesh-independent metrics contract).  The quarantine is visible on
+   ``/debug/devices``, ``sm_device_quarantines_total`` and
+   ``sm_device_health{device="3"}`` on ``/metrics``, and no lease after
+   the quarantine includes chip 3;
+3. **half-open readmission**: the simulated fault is lifted; after the
+   re-probe cooldown the chip is readmitted and the next 4-chip submit
+   holds all four chips again.
+
+Without ``--smoke``, two more stages run: a **transient** fault
+(ConnectionError class) that retries on the same chips with NO
+quarantine, and a **host eviction** where quarantining enough of one host
+domain's chips fences the whole domain.
+
+Exit 0 = gate passes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+from pathlib import Path
+
+# the virtual 8-chip mesh must exist BEFORE jax initializes (same dance as
+# multichip_smoke.py / tests/conftest.py)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+from scripts.load_sweep import Harness, _msg, build_fixtures  # noqa: E402
+from sm_distributed_tpu.models import faults  # noqa: E402
+from sm_distributed_tpu.utils import failpoints  # noqa: E402
+
+POOL = 4
+
+
+def fail(msg: str) -> int:
+    print(f"device_chaos: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def _get(h: Harness, path: str):
+    with urllib.request.urlopen(h.base + path, timeout=30.0) as r:
+        return json.loads(r.read())
+
+
+def _trace_records(h: Harness, msg_id: str) -> list[dict]:
+    return _get(h, f"/jobs/{msg_id}/trace?raw=1")["records"]
+
+
+def _stored(h: Harness, ds_id: str) -> pd.DataFrame:
+    p = Path(h.sm_config.storage.results_dir) / ds_id / "annotations.parquet"
+    return pd.read_parquet(p).sort_values(
+        ["sf", "adduct"]).reset_index(drop=True)
+
+
+def _leases(records: list[dict]) -> list[tuple[float, list[int]]]:
+    """(ts, chip list) of every device_token_acquired event, in order."""
+    return [(float(r["ts"]), list((r.get("attrs") or {}).get("devices", [])))
+            for r in records
+            if r["kind"] == "event"
+            and r["name"] == "device_token_acquired"]
+
+
+def run(work: Path, smoke: bool) -> int:
+    if len(jax.devices()) < 8:
+        return fail(f"virtual mesh failed: {len(jax.devices())} devices")
+    from sm_distributed_tpu.analysis import lockorder
+
+    lockorder.enable()
+    fx = build_fixtures(work)
+    h = Harness(work, "device_chaos", sm_overrides={
+        "backend": "jax_tpu",
+        "parallel": {"formula_batch": 2, "checkpoint_every": 1},
+        "service": {"workers": 1, "max_attempts": 3,
+                    "device_pool_size": POOL, "devices_per_job": POOL,
+                    "health_reprobe_after_s": 0.5,
+                    "backoff_base_s": 0.05, "backoff_max_s": 0.2},
+    })
+    health = h.service.device_pool.health
+    try:
+        # ---- 1. fault-free 4-chip golden --------------------------------
+        status, _hd, _b = h.submit(_msg(fx, "fast", "golden4", devices=POOL))
+        if status != 202:
+            return fail(f"golden submit returned {status}")
+        rows = h.wait_terminal(["golden4"])
+        if rows["golden4"]["state"] != "done":
+            return fail(f"golden job {rows['golden4']['state']}: "
+                        f"{rows['golden4']['error']!r}")
+        golden = _stored(h, "golden4")
+        g_leases = _leases(_trace_records(h, "golden4"))
+        if not g_leases or g_leases[-1][1] != [0, 1, 2, 3]:
+            return fail(f"golden lease {g_leases}, wanted all {POOL} chips")
+        print(f"device_chaos: golden 4-chip job OK "
+              f"({len(golden)} annotations)")
+
+        # ---- 2. sticky chip death mid-sharded-job -----------------------
+        # the job is granted all 4 chips first (lease-time probes pass),
+        # THEN chip 3's hardware dies mid-run: each group's scoring sleeps
+        # so the fault (2nd group) lands well after the grant, and the
+        # probe seam starts reporting chip 3 bad the moment the job is
+        # seen holding its 4-chip lease.  The sticky fault at group 1 is
+        # probe-attributed to chip 3, which is quarantined; the retry
+        # re-leases the 3 survivors and resumes from the group-0 ckpt.
+        failpoints.configure("device.score_batch=sleep:0.4;"
+                             "backend.chip_fault=raise:RuntimeError@2")
+        try:
+            status, _hd, _b = h.submit(
+                _msg(fx, "fast", "fault4", devices=POOL))
+            if status != 202:
+                return fail(f"fault submit returned {status}")
+            deadline = time.time() + 60.0
+            granted = False
+            while time.time() < deadline and not granted:
+                try:
+                    granted = any(devs == [0, 1, 2, 3] for _ts, devs
+                                  in _leases(_trace_records(h, "fault4")))
+                except (OSError, ValueError, KeyError):
+                    granted = False   # trace not started yet (404/empty)
+                if not granted:
+                    time.sleep(0.05)
+            if not granted:
+                return fail("fault job never acquired the 4-chip lease")
+            health.simulate_bad({3})   # the chip dies mid-job
+            rows = h.wait_terminal(["fault4"])
+        finally:
+            failpoints.configure(None)
+        if rows["fault4"]["state"] != "done":
+            return fail(f"fault job {rows['fault4']['state']}: "
+                        f"{rows['fault4']['error']!r}")
+        if rows["fault4"]["attempts"] < 2:
+            return fail("fault job finished in one attempt — the sticky "
+                        "fault never fired")
+        # exactly-once completion: one done/ copy, no other spool state
+        spool = {s: sorted(p.name for p in (h.root / s).glob("fault4.json"))
+                 for s in ("pending", "running", "done", "failed",
+                           "quarantine")}
+        if spool["done"] != ["fault4.json"] or any(
+                v for k, v in spool.items() if k != "done"):
+            return fail(f"fault4 spool message lost/duplicated: {spool}")
+
+        # bit-identical convergence on the shrunken mesh
+        got = _stored(h, "fault4")
+        try:
+            pd.testing.assert_frame_equal(got, golden, check_exact=True)
+        except AssertionError as exc:
+            return fail("3-chip rescore diverged from the 4-chip golden: "
+                        + str(exc).splitlines()[-1])
+
+        # quarantine visible + honored by every later lease
+        records = _trace_records(h, "fault4")
+        quarantines = [r for r in records if r["kind"] == "event"
+                       and r["name"] == "device_quarantine"]
+        if not quarantines or quarantines[0]["attrs"]["device"] != 3:
+            return fail(f"no device_quarantine event for chip 3: "
+                        f"{[q.get('attrs') for q in quarantines]}")
+        q_ts = float(quarantines[0]["ts"])
+        leases = _leases(records)
+        after = [devs for ts, devs in leases if ts > q_ts]
+        if not after or after[-1] != [0, 1, 2]:
+            return fail(f"retry lease after quarantine was {after}, wanted "
+                        f"the 3 survivors [0, 1, 2]")
+        if any(3 in devs for devs in after):
+            return fail(f"a lease after the quarantine included chip 3: "
+                        f"{after}")
+        dev = _get(h, "/debug/devices")
+        chip3 = next(c for c in dev["health"]["chips"] if c["device"] == 3)
+        if chip3["state"] != "quarantined":
+            return fail(f"/debug/devices chip 3 state {chip3['state']}")
+        text = h.metrics_text()
+        if "sm_device_quarantines_total 1" not in text.replace(".0", ""):
+            if "sm_device_quarantines_total" not in text:
+                return fail("/metrics lacks sm_device_quarantines_total")
+        if 'sm_device_health{device="3"} 2' not in text:
+            return fail('/metrics lacks sm_device_health{device="3"} == 2')
+        resumed = [r for r in records if r["kind"] == "event"
+                   and r["name"] == "device_fault"]
+        if not resumed or resumed[0]["attrs"]["kind"] != "sticky":
+            return fail(f"no sticky device_fault event: {resumed}")
+        print("device_chaos: sticky chip 3 quarantined mid-job; job "
+              "resumed from checkpoint on chips [0, 1, 2] — stored "
+              "annotations BIT-IDENTICAL to the 4-chip golden")
+
+        # ---- 3. half-open readmission -----------------------------------
+        health.simulate_bad(())
+        deadline = time.time() + 10.0
+        readmitted = []
+        while time.time() < deadline and not readmitted:
+            time.sleep(0.2)
+            readmitted = health.reprobe_due()
+        if 3 not in readmitted:
+            return fail(f"chip 3 never readmitted (got {readmitted})")
+        status, _hd, _b = h.submit(_msg(fx, "fast", "after4", devices=POOL))
+        if status != 202:
+            return fail(f"post-readmit submit returned {status}")
+        rows = h.wait_terminal(["after4"])
+        if rows["after4"]["state"] != "done":
+            return fail(f"post-readmit job {rows['after4']['state']}")
+        leases = _leases(_trace_records(h, "after4"))
+        if not leases or leases[-1][1] != [0, 1, 2, 3]:
+            return fail(f"post-readmit lease {leases}, wanted all 4 chips")
+        if "sm_device_readmits_total" not in h.metrics_text():
+            return fail("/metrics lacks sm_device_readmits_total")
+        print("device_chaos: chip 3 READMITTED after a passing re-probe; "
+              "next job holds all 4 chips again")
+
+        if not smoke:
+            rc = _extra_stages(h, fx, health)
+            if rc:
+                return rc
+
+        rep = lockorder.assert_no_cycles("device_chaos")
+        print(f"device_chaos: lock-order clean "
+              f"({rep['locks_instrumented']} locks, {rep['edges']} edges)")
+        return 0
+    finally:
+        h.shutdown()
+        lockorder.disable()
+
+
+def _extra_stages(h: Harness, fx: dict, health) -> int:
+    # ---- transient fault: same chips retried, nothing quarantined -------
+    before = health.snapshot()["quarantines_total"]
+    failpoints.configure("backend.chip_fault=raise:ConnectionError@1")
+    try:
+        status, _hd, _b = h.submit(_msg(fx, "fast", "transient4", devices=POOL))
+        if status != 202:
+            return fail(f"transient submit returned {status}")
+        rows = h.wait_terminal(["transient4"])
+    finally:
+        failpoints.configure(None)
+    if rows["transient4"]["state"] != "done":
+        return fail(f"transient job {rows['transient4']['state']}")
+    snap = health.snapshot()
+    if snap["quarantines_total"] != before:
+        return fail("a transient fault caused a quarantine")
+    t_records = _trace_records(h, "transient4")
+    t_faults = [r for r in t_records if r["kind"] == "event"
+                and r["name"] == "device_fault"]
+    if not t_faults or t_faults[0]["attrs"]["kind"] != "transient":
+        return fail(f"no transient device_fault event: {t_faults}")
+    print("device_chaos: transient fault retried in place — zero "
+          "quarantines, job done")
+
+    # ---- host eviction: the tracker fences a failing domain -------------
+    from sm_distributed_tpu.service.health import HealthTracker
+
+    ht = HealthTracker(8, hosts=2, host_evict_fraction=0.75,
+                       probe_on_lease=False, reprobe_after_s=0.0)
+    for chip in (0, 1, 2):
+        ht.report_fault((chip,), faults.FAULT_STICKY, "probe says dead")
+    snap = ht.snapshot()
+    states = [c["state"] for c in snap["chips"]]
+    if states[:4] != ["quarantined"] * 4:
+        return fail(f"host 0 not fully evicted at 3/4 chips out: {states}")
+    if states[4:] != ["ok"] * 4 or snap["host_evictions_total"] != 1:
+        return fail(f"host eviction spilled past the domain: {snap}")
+    print("device_chaos: host 0 evicted at 3/4 chips quarantined; "
+          "host 1 untouched")
+    return 0
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI subset: golden + sticky-quarantine + readmit")
+    ap.add_argument("--work", default=None)
+    ap.add_argument("--keep", action="store_true")
+    args = ap.parse_args()
+    if args.work:
+        work = Path(args.work)
+        work.mkdir(parents=True, exist_ok=True)
+        return run(work, smoke=args.smoke)
+    with tempfile.TemporaryDirectory(prefix="sm_device_chaos_") as d:
+        rc = run(Path(d), smoke=args.smoke)
+        if args.keep:
+            print(f"device_chaos: work dir kept at {d}", file=sys.stderr)
+        return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

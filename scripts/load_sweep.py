@@ -26,6 +26,11 @@ serving invariants after each mix:
   device errors (``backend.device_error`` failpoint), the circuit breaker
   demonstrably opens, jobs degrade to numpy scoring, and after the faults
   are healed a half-open probe closes it again;
+- **device_fault** (full matrix only, ISSUE 14): a 24-job surge over an
+  8-chip pool with one chip going sticky mid-sweep — the chip is
+  quarantined (``service/health.py``), no later grant includes it, every
+  job lands in ``done/`` exactly once, and p99 queue-wait stays bounded
+  despite the 7/8 pool;
 - **disk** (full matrix only, ISSUE 10): sustained traffic under a 64 MB
   disk budget already past the trace floor — jobs complete with trace
   writes dropped, deepening pressure sheds submits with a structured 507
@@ -427,9 +432,12 @@ def mix_breaker(base: Path, fx: dict) -> None:
         _check(all(rows[m]["state"] == "done" for m in ids),
                f"breaker: jobs under device faults not done: "
                f"{[(m, rows[m]['state']) for m in ids]}")
-        brk = breaker_mod.get_device_breaker()
-        _check(brk.state == "open",
-               f"breaker: expected open after injected faults, got {brk.state}")
+        # per-chip breakers (ISSUE 14): the leased jobs answer to their
+        # CHIP's breaker, not the un-leased "*" singleton
+        brk = breaker_mod.breaker_for("0")
+        _check(brk is not None and brk.state == "open",
+               f"breaker: expected chip-0 breaker open after injected "
+               f"faults, got {brk.state if brk else 'absent'}")
         # heal the device, wait out the cooldown, probe
         failpoints.configure(None)
         time.sleep(h.sm_config.service.breaker_cooldown_s + 0.1)
@@ -447,8 +455,10 @@ def mix_breaker(base: Path, fx: dict) -> None:
             _check(hop in hops, f"breaker: transition {hop} missing: {hops}")
         text = h.metrics_text()
         _check("sm_breaker_degraded_total" in text
-               and 'sm_breaker_transitions_total{to="open"}' in text,
-               "breaker: /metrics missing breaker families")
+               and 'sm_breaker_transitions_total{device="0",to="open"}'
+               in text,
+               "breaker: /metrics missing breaker families (per-chip "
+               "device label, ISSUE 14)")
         h.assert_clean("breaker")
         print(f"  breaker: opened, degraded to numpy, recovered "
               f"(transitions {hops})")
@@ -522,6 +532,105 @@ def mix_disk(base: Path, fx: dict) -> None:
     finally:
         if filler.exists():
             filler.unlink()
+        h.shutdown()
+
+
+def mix_device_fault(base: Path, fx: dict, n_jobs: int = 24,
+                     p99_bound_s: float = 20.0) -> None:
+    """Surge mix where one chip goes sticky mid-sweep (ISSUE 14): 24 jobs
+    across 3 tenants over an 8-chip pool; once the surge is in flight,
+    chip 3 takes an attributed sticky fault and is quarantined.  Asserts:
+    every job terminal in ``done/`` exactly once, zero lost/dup spool
+    messages, NO lease granted on the quarantined chip afterwards, p99
+    queue-wait bounded despite the 7/8 pool, and the quarantine visible on
+    /metrics.  Jobs score on numpy_ref — the pool is a scheduling-plane
+    resource here, so the mix measures placement, not kernels."""
+    from sm_distributed_tpu.models import faults as faults_mod
+
+    h = Harness(base, "device_fault", sm_overrides={
+        "service": {"workers": 6, "device_pool_size": 8,
+                    "devices_per_job": 1, "max_attempts": 2,
+                    "admission": {"max_queue_depth": 64,
+                                  "max_tenant_inflight": 32}},
+    })
+    pool = h.service.device_pool
+    granted_on_dead: list[dict] = []
+    stop = threading.Event()
+    quarantined_at = [0.0]
+    holder_at_quarantine = [None]
+
+    def _watch():
+        # no NEW grant may include chip 3 after its quarantine (a lease
+        # already holding it when the verdict lands finishes on its own —
+        # quarantine fences placement, it does not revoke)
+        while not stop.wait(0.01):
+            if not quarantined_at[0]:
+                continue
+            snap = pool.snapshot()
+            holder = snap["holders"].get("3")
+            if holder is not None and holder != holder_at_quarantine[0]:
+                granted_on_dead.append(snap["holders"])
+
+    watcher = threading.Thread(target=_watch, daemon=True)
+    watcher.start()
+    try:
+        # every batch-group score sleeps, so the surge keeps the pool busy
+        # long enough for the mid-sweep fault to land under load
+        failpoints.configure("device.score_batch=sleep:0.1")
+        ids = []
+        for i in range(n_jobs):
+            status, _hd, body = h.submit(
+                _msg(fx, "fast", f"df{i}", tenant=f"t{i % 3}"))
+            _check(status == 202,
+                   f"device_fault: submit {i} shed ({status})")
+            ids.append(body["msg_id"])
+            if i == n_jobs // 3:
+                # mid-sweep: chip 3 goes sticky (1-chip attribution —
+                # quarantined outright, models/faults.py taxonomy)
+                holder_at_quarantine[0] = (
+                    pool.snapshot()["holders"].get("3"))
+                faults_mod.report_device_fault(
+                    (3,), faults_mod.FAULT_STICKY, "sweep-injected sticky")
+                quarantined_at[0] = time.time()
+                _check(pool.health.state_of(3) == "quarantined",
+                       "device_fault: chip 3 not quarantined")
+        rows = h.wait_terminal(ids, timeout_s=180.0)
+        bad = [m for m in ids if rows[m]["state"] != "done"]
+        _check(not bad, f"device_fault: jobs not done: "
+                        f"{[(m, rows[m]['state']) for m in bad]}")
+        # exactly-once: every job in done/ once, nowhere else
+        done = sorted(p.stem for p in (h.root / "done").glob("df*.json"))
+        _check(done == sorted(ids),
+               f"device_fault: done/ census mismatch ({len(done)} vs "
+               f"{len(ids)})")
+        for state in ("pending", "running", "failed", "quarantine"):
+            leftover = list((h.root / state).glob("df*.json"))
+            _check(not leftover,
+                   f"device_fault: {state}/ not empty: {leftover}")
+        _check(not granted_on_dead,
+               f"device_fault: quarantined chip 3 appeared in grants: "
+               f"{granted_on_dead[:3]}")
+        # p99 queue wait bounded despite the 7/8 pool
+        waits = sorted(max(0.0, rows[m]["started_at"]
+                           - rows[m]["published_at"]) for m in ids)
+        p99 = waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+        _check(p99 <= p99_bound_s,
+               f"device_fault: p99 queue wait {p99:.2f}s > {p99_bound_s}s")
+        text = h.metrics_text()
+        _check("sm_device_quarantines_total 1" in text
+               or "sm_device_quarantines_total" in text
+               and pool.health.snapshot()["quarantines_total"] >= 1,
+               "device_fault: quarantine not on /metrics")
+        _check('sm_device_health{device="3"} 2' in text,
+               "device_fault: sm_device_health gauge missing/incorrect")
+        h.assert_clean("device_fault")
+        print(f"  device_fault: {n_jobs} jobs done exactly-once on the "
+              f"7/8 pool (p99 queue wait {p99:.2f}s), chip 3 quarantined "
+              f"and never re-leased")
+    finally:
+        stop.set()
+        watcher.join(timeout=2.0)
+        failpoints.configure(None)
         h.shutdown()
 
 
@@ -841,6 +950,7 @@ def run_sweep(work: Path, smoke: bool = False,
                 h.shutdown()
             if not smoke:
                 mix_breaker(work, fx)
+                mix_device_fault(work, fx)
                 mix_disk(work, fx)
                 mix_replicas(work)
                 mix_elastic(work)

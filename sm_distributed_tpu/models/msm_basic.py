@@ -33,8 +33,9 @@ from ..utils.cancel import JobCancelledError
 from ..utils.config import DSConfig, SMConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger, phase_timer
-from . import oom
+from . import faults, oom
 from .breaker import get_device_breaker, record_degraded
+from .faults import FP_CHIP_FAULT
 
 FP_SHARD_WRITE = register_failpoint(
     "ckpt.shard_write",
@@ -627,11 +628,17 @@ class MSMBasicSearch:
         backend-independent (bit-exact parity), so a mid-job switch is
         invisible in the results.
 
-        HBM ``RESOURCE_EXHAUSTED`` is classified FIRST (models/oom.py):
-        it is a sizing signal, not a device fault — the batch halves and
-        the group rescores in place, the breaker never counts it, and the
-        converged size is remembered so the next job on this shape starts
-        there.  Returns the (possibly swapped) backend and degraded flag."""
+        Every non-cancel exception routes through the ONE fault taxonomy
+        (models/faults.py, ISSUE 14).  HBM ``RESOURCE_EXHAUSTED`` is a
+        *sizing* signal: the batch halves and the group rescores in place,
+        the breaker never counts it, and the converged size is remembered
+        so the next job on this shape starts there.  A *transient* fault
+        (collective timeout, dying tunnel) fails the attempt into the
+        retry policy — same chip, backoff, no breaker count, no
+        quarantine.  A *sticky* fault reports the lease chips to the
+        health tracker (quarantine / probe attribution) AND counts on the
+        per-chip breaker.  Returns the (possibly swapped) backend and
+        degraded flag."""
         on_device = use_device and not degraded
         slices = self._reduced_slices(group) if degraded else group
         if self._oom_cap:
@@ -645,6 +652,9 @@ class MSMBasicSearch:
                     # injected consecutive-device-error seam (chaos sweep:
                     # breaker opens mid-job, degrades, converges to golden)
                     failpoint(FP_DEVICE_ERROR)
+                    # classified chip-fault seam (ISSUE 14): the injected
+                    # exception class selects the taxonomy — see faults.py
+                    failpoint(FP_CHIP_FAULT)
                 # lazy slices: every backend exposes score_batches; the jax
                 # one pipelines (async-enqueues all batches in the group
                 # before syncing any), the numpy one consumes one at a time
@@ -654,10 +664,12 @@ class MSMBasicSearch:
             except JobCancelledError:
                 raise
             except Exception as exc:
-                injected = "backend.device_error" in str(exc)
+                injected = ("backend.device_error" in str(exc)
+                            or "backend.chip_fault" in str(exc))
                 if not (on_device or injected):
                     raise             # a host-backend bug is not a device fault
-                if oom.is_oom_error(exc):
+                kind = faults.classify(exc)
+                if kind == faults.FAULT_OOM:
                     new_cap = self._oom_backoff(backend, slices, oom_cap, exc)
                     if not new_cap:
                         raise         # single-ion batch still OOMs: let the
@@ -665,9 +677,21 @@ class MSMBasicSearch:
                     oom_cap = new_cap
                     slices = self._capped_slices(slices, new_cap)
                     continue
+                faults.report_device_fault(self.device_indices, kind, exc)
+                if kind == faults.FAULT_TRANSIENT:
+                    # known-recoverable runtime hiccup: the retry policy
+                    # probes the SAME chip after backoff — no breaker
+                    # count, no quarantine (the regression the old breaker
+                    # test caused: a collective timeout opened it)
+                    logger.warning(
+                        "transient device fault while scoring — retrying "
+                        "via the job retry policy (no breaker count): %s",
+                        exc)
+                    raise
                 now_open = breaker.record_failure()
                 logger.warning(
-                    "device error while scoring (breaker %s after it): %s",
+                    "sticky device fault while scoring (breaker %s after "
+                    "it; lease chips reported for quarantine): %s",
                     breaker.state, exc)
                 if not now_open:
                     raise             # below threshold: let the retry policy
@@ -688,8 +712,10 @@ class MSMBasicSearch:
             else:
                 if on_device:
                     # a cleanly scored device group closes a half-open probe
-                    # and resets the consecutive-error count
+                    # and resets the consecutive-error count — and clears
+                    # the lease chips' suspect state (ISSUE 14)
                     breaker.record_success()
+                    faults.report_device_ok(self.device_indices)
                 break
         if oom_cap:
             # the group converged at oom_cap: proven-safe — later groups
@@ -838,7 +864,11 @@ class MSMBasicSearch:
         # reduced batch (bit-identical results; degraded-but-correct beats
         # dead).  allow_device() admits one half-open probe after cooldown.
         use_device = self.sm_config.backend == "jax_tpu"
-        breaker = get_device_breaker(self.sm_config.service)
+        # per-chip breaker view (ISSUE 14): a leased job answers to ITS
+        # chips' breakers, so one bad chip's history never degrades jobs
+        # holding healthy chips; un-leased runs keep the "*" singleton
+        breaker = get_device_breaker(self.sm_config.service,
+                                     devices=self.device_indices)
         degraded = False
         if use_device and not breaker.allow_device():
             logger.warning(

@@ -157,6 +157,11 @@ _SPEC_KEYS = (
     "w_cap",              # band-variant capacity (0 = n/a)
     "g", "c", "wc",       # bound-grid / chunk-plan shapes
     "devices",            # lease shape: chip count (1 = single device)
+    # sharded (mesh-shaped) executables only — absent (None) on flat specs
+    # so pre-existing manifest keys stay stable within a kind:
+    "mesh_pix", "mesh_form",  # mesh axis sizes (pixels x formulas)
+    "p_loc",              # per-shard pixel capacity (whole bucketed rows)
+    "w",                  # total window count (the inv permutation length)
 )
 
 

@@ -535,17 +535,25 @@ class ShardedJaxBackend:
         nb_d = jax.device_put(nb, self._pos_sharding)
         nr_d = jax.device_put(self._n_real_host, self._rep_sharding)
         if self._buckets:
-            shape_buckets.record_spec(self._sharded_spec(variant, key))
+            shape_buckets.record_spec(
+                self._sharded_spec(variant, key, pos, starts, rlo, inv,
+                                   ints_p))
         out = self._fns[key](self._px_s, self._in_s, pos_d, starts_d,
                              rlo_d, rhi_d, inv_d, ints_d, nv_d,
                              rp_d, rd_d, nb_d, nr_d)
         return out, table.n_ions
 
-    def _sharded_spec(self, variant: str, key: tuple) -> dict:
+    def _sharded_spec(self, variant: str, key: tuple, pos, starts, rlo,
+                      inv, ints_p) -> dict:
         """BucketSpec of one sharded step executable (ops/buckets.py) —
-        recorded for the /debug/compile lattice view; the AOT primer
-        rebuilds it only on hosts whose visible device count matches the
-        mesh (the executable is mesh-shaped)."""
+        recorded for the /debug/compile lattice view AND for the AOT
+        primer (service/primer.py), which since ISSUE 14 rebuilds the
+        byte-identical mesh-shaped program from it on any host whose
+        visible device count covers the mesh.  The spec therefore carries
+        the full lease topology (mesh axes, per-shard pixel capacity) and
+        every host-plan shape the step's avals depend on — a
+        post-quarantine SHRUNKEN mesh records its own spec at first
+        dispatch and is warm for every later job of that lease shape."""
         gc, n_keep, w_cap = key
         img = self.ds_config.image_generation
         return {
@@ -555,11 +563,15 @@ class ShardedJaxBackend:
             "do_preprocessing": bool(img.do_preprocessing),
             "q": float(img.q),
             "n_resident": int(self._px_s.shape[1]),
-            "b": int(self.batch), "k": 0,
+            "b": int(self.batch), "k": int(ints_p.shape[1]),
             "gc_width": int(gc), "n_keep": int(n_keep),
             "r_pad": int(self._r_pad), "w_cap": int(w_cap),
-            "g": 0, "c": 0, "wc": 0,
+            "g": int(pos.shape[1]), "c": int(starts.shape[0]),
+            "wc": int(rlo.shape[1]), "w": int(inv.shape[0]),
             "devices": int(self.mesh.size),
+            "mesh_pix": int(self.mesh.shape[PIXELS_AXIS]),
+            "mesh_form": int(self.mesh.shape[FORMULAS_AXIS]),
+            "p_loc": int(self._p_loc),
         }
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:

@@ -44,7 +44,11 @@ RabbitMQ's management UI):
 - ``GET /debug/compile``  the cold-start lattice view (ISSUE 13): every
   recorded shape bucket with primed/missing status (``service/primer.py``)
   plus the runtime retrace census per attributed call site
-  (``analysis/retrace.py``).
+  (``analysis/retrace.py``);
+- ``GET /debug/devices``  the chip-level device-pool view (ISSUE 14):
+  per-chip health state + fault strikes + quarantine evidence
+  (``service/health.py``), lease holders, probe/quarantine/readmit/
+  host-eviction totals, and per-chip breaker states.
 
 ``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
 handler is read-only except ``/submit`` (appends to ``pending/``) and
@@ -164,6 +168,9 @@ class AdminAPI:
                             200, tracing.flight_recorder.recent(n))
                     elif url.path == "/debug/resources":
                         status, body = api._resources()
+                        self._reply_json(status, body)
+                    elif url.path == "/debug/devices":
+                        status, body = api._devices()
                         self._reply_json(status, body)
                     elif url.path == "/debug/compile":
                         status, body = api._compile()
@@ -395,6 +402,21 @@ class AdminAPI:
             },
         }
         return 200, body
+
+    def _devices(self) -> tuple[int, dict]:
+        """``GET /debug/devices`` (ISSUE 14) — the device pool's chip-level
+        view: per-chip health (``ok``/``suspect``/``quarantined`` with
+        fault strikes, quarantine reason and timestamp), current lease
+        holders, per-host occupancy, probe/quarantine/readmit/eviction
+        totals (``service/health.py``), and every per-chip circuit
+        breaker's state (``models/breaker.py``)."""
+        pool = getattr(self.service, "device_pool", None)
+        if pool is None:
+            return 404, {"error": "device pool not configured",
+                         "reason": "not_found"}
+        from ..models.breaker import breakers_snapshot
+
+        return 200, {**pool.snapshot(), "breakers": breakers_snapshot()}
 
     def _resources(self) -> tuple[int, dict]:
         """``GET /debug/resources`` — the resource governor's snapshot

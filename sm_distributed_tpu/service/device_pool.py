@@ -43,6 +43,7 @@ import threading
 import time
 
 from ..utils.logger import logger
+from .health import HealthTracker
 
 
 class DeviceLease:
@@ -105,7 +106,8 @@ class DevicePool:
                    "_compat": "_cond", "grants_total": "_cond",
                    "releases_total": "_cond", "leases_reaped_total": "_cond"}
 
-    def __init__(self, size: int, max_bypass: int = 64, hosts: int = 1):
+    def __init__(self, size: int, max_bypass: int = 64, hosts: int = 1,
+                 health: HealthTracker | None = None):
         if size <= 0:
             raise ValueError(f"device pool size must be positive, got {size}")
         self.size = int(size)
@@ -125,6 +127,12 @@ class DevicePool:
             hosts = 1
         self.hosts = hosts
         self.chips_per_host = self.size // hosts
+        # per-chip health (ISSUE 14, service/health.py): quarantined chips
+        # are excluded from grants, granted chips are lease-time probed,
+        # and a half-open re-probe readmits recovered chips.  The tracker
+        # has its own leaf lock; the pool always takes _cond first.
+        self.health = health if health is not None else \
+            HealthTracker(self.size, hosts=self.hosts)
         self._cond = threading.Condition()
         self._owner: list[DeviceLease | None] = [None] * self.size
         self._waiters: list[DeviceLease] = []
@@ -166,6 +174,9 @@ class DevicePool:
             "sm_device_pool_leases_reaped_total",
             "Abandoned-attempt leases reclaimed by the zombie reaper",
             ("reason",))
+        # per-chip health family (ISSUE 14): sm_device_health{device=},
+        # quarantines/probes/readmits/host-evictions counters
+        self.health.attach_metrics(registry)
 
     # ---------------------------------------------------------- inspection
     def lease(self, n: int, msg_id: str = "") -> DeviceLease:
@@ -195,6 +206,7 @@ class DevicePool:
 
     def snapshot(self) -> dict:
         """One point-in-time view (telemetry ring / debugging)."""
+        health = self.health.snapshot()
         with self._cond:
             per_host = [0] * self.hosts
             for i, o in enumerate(self._owner):
@@ -210,25 +222,45 @@ class DevicePool:
                 "holders": {
                     str(i): o.msg_id for i, o in enumerate(self._owner)
                     if o is not None},
+                "health": health,
             }
 
     # ---------------------------------------------------- grant machinery
-    def _find_run(self, n: int) -> int | None:
-        """First start index of a contiguous free run of length ``n``,
-        preferring a run that stays within ONE host (fewest failure
-        domains, no cross-host collectives); a lease wider than a host —
-        or a pool too fragmented for a single-host run — falls back to any
-        contiguous run spanning the host boundary."""
-        if self.hosts > 1 and n <= self.chips_per_host:
-            single = self._scan_run(n, within_host=True)
-            if single is not None:
-                return single
-        return self._scan_run(n, within_host=False)
+    def _find_chips(self, n: int) -> tuple[int, ...] | None:
+        """The chips a grant of ``n`` would take right now (caller holds
+        the lock), or None.  Quarantined chips (``service/health.py``) are
+        excluded as if permanently busy.  Preference order: a contiguous
+        run within ONE host (fewest failure domains, no cross-host
+        collectives), then any contiguous run, then — ONLY when quarantine
+        has fragmented the pool — a non-contiguous pick of free healthy
+        chips (warned at grant; a healthy-but-busy pool still waits for a
+        contiguous run, exactly the pre-health semantics).  A request
+        larger than the surviving healthy pool clamps down to it (the
+        mesh-shrink path: the job reshapes rather than waiting forever)."""
+        quarantined = self.health.quarantined()
+        healthy_total = self.size - len(quarantined)
+        if healthy_total <= 0:
+            return None
+        n_eff = min(n, healthy_total)
+        if self.hosts > 1 and n_eff <= self.chips_per_host:
+            start = self._scan_run(n_eff, True, quarantined)
+            if start is not None:
+                return tuple(range(start, start + n_eff))
+        start = self._scan_run(n_eff, False, quarantined)
+        if start is not None:
+            return tuple(range(start, start + n_eff))
+        if quarantined:
+            free = [i for i in range(self.size)
+                    if self._owner[i] is None and i not in quarantined]
+            if len(free) >= n_eff:
+                return tuple(free[:n_eff])   # host-major order
+        return None
 
-    def _scan_run(self, n: int, within_host: bool) -> int | None:
+    def _scan_run(self, n: int, within_host: bool,
+                  quarantined: frozenset[int]) -> int | None:
         run = 0
         for i in range(self.size):
-            if self._owner[i] is None:
+            if self._owner[i] is None and i not in quarantined:
                 if within_host and run and \
                         i % self.chips_per_host == 0:
                     run = 0           # a host boundary breaks the run
@@ -248,13 +280,14 @@ class DevicePool:
         for w in self._waiters:
             if w is lease:
                 return True
-            if self._find_run(w.n) is not None:
+            if self._find_chips(w.n) is not None:
                 return False
             if w._bypassed >= self.max_bypass:
                 return False
         return True
 
-    def _grant_locked(self, lease: DeviceLease, start: int) -> None:
+    def _grant_locked(self, lease: DeviceLease,
+                      chips: tuple[int, ...]) -> None:
         # caller holds self._cond
         for w in self._waiters:
             if w is lease:
@@ -262,7 +295,17 @@ class DevicePool:
             w._bypassed += 1
         self._waiters.remove(lease)
         lease._queued = False
-        lease.devices = tuple(range(start, start + lease.n))
+        lease.devices = tuple(chips)
+        if len(chips) < lease.n:
+            logger.warning(
+                "device pool: clamped %d-chip lease for %s to the %d "
+                "surviving healthy chip(s) %s (quarantine shrank the pool)",
+                lease.n, lease.msg_id or "anonymous", len(chips), chips)
+        if any(b - a != 1 for a, b in zip(chips, chips[1:])):
+            logger.warning(
+                "device pool: NON-CONTIGUOUS grant %s for %s — quarantine "
+                "fragmented the pool (cross-chip collectives may cross "
+                "fenced slots)", chips, lease.msg_id or "anonymous")
         for i in lease.devices:
             self._owner[i] = lease
         self.grants_total += 1
@@ -279,33 +322,70 @@ class DevicePool:
         deadline = (time.monotonic() + timeout
                     if blocking and timeout is not None and timeout >= 0
                     else None)
-        with self._cond:
-            if lease.devices:
-                raise RuntimeError(
-                    f"lease for {lease.msg_id or 'anonymous'} already holds "
-                    f"devices {lease.devices}")
-            if not lease._queued:
-                lease._queued = True
-                lease._bypassed = 0
-                lease._waiting_since = time.monotonic()
-                self._waiters.append(lease)
-                if self._m_waiters is not None:
-                    self._m_waiters.set(len(self._waiters))
-            while True:
-                if self._grant_allowed(lease):
-                    start = self._find_run(lease.n)
-                    if start is not None:
-                        self._grant_locked(lease, start)
-                        return True
-                if not blocking:
-                    return False     # stays queued — position is retained
-                if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
+        # half-open recovery (ISSUE 14): quarantined chips past their
+        # re-probe cooldown get one probe here, OUTSIDE the pool lock —
+        # a recovered chip rejoins the pool before this grant is evaluated
+        self.health.reprobe_due()
+        while True:
+            granted = False
+            with self._cond:
+                if lease.devices:
+                    raise RuntimeError(
+                        f"lease for {lease.msg_id or 'anonymous'} already "
+                        f"holds devices {lease.devices}")
+                if not lease._queued:
+                    lease._queued = True
+                    lease._bypassed = 0
+                    lease._waiting_since = time.monotonic()
+                    self._waiters.append(lease)
+                    if self._m_waiters is not None:
+                        self._m_waiters.set(len(self._waiters))
+                while True:
+                    if self._grant_allowed(lease):
+                        chips = self._find_chips(lease.n)
+                        if chips is not None:
+                            self._grant_locked(lease, chips)
+                            granted = True
+                            break
+                    if not blocking:
                         return False  # stays queued — position is retained
-                    self._cond.wait(remaining)
-                else:
-                    self._cond.wait()
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            return False  # stays queued — position retained
+                        self._cond.wait(remaining)
+                    else:
+                        self._cond.wait()
+            # lease-time health probe (ISSUE 14), outside the lock: device
+            # work must never serialize the pool.  A probe failure
+            # quarantines the chip; the grant is returned and re-evaluated
+            # over the survivors (position kept at the queue head).
+            bad = self.health.probe_lease(lease.devices)
+            if not bad:
+                return True
+            logger.warning(
+                "device pool: lease-time probe quarantined chip(s) %s — "
+                "re-granting %s from the surviving pool", bad,
+                lease.msg_id or "anonymous")
+            self._regrant(lease)
+
+    def _regrant(self, lease: DeviceLease) -> None:
+        """Return a probe-rejected grant's chips and requeue the lease at
+        the FRONT (it had already won the FIFO race; the probe verdict
+        must not cost it its place in line)."""
+        with self._cond:
+            for i in lease.devices:
+                if self._owner[i] is lease:
+                    self._owner[i] = None
+            if self._m_in_use is not None:
+                for i in lease.devices:
+                    self._m_in_use.labels(device=str(i)).set(0)
+            lease.devices = ()
+            lease._queued = True
+            self._waiters.insert(0, lease)
+            if self._m_waiters is not None:
+                self._m_waiters.set(len(self._waiters))
+            self._cond.notify_all()
 
     def _release(self, lease: DeviceLease) -> None:
         """Idempotent: frees granted chips, or deregisters a still-waiting

@@ -26,11 +26,18 @@ instead of paying 40–120 s of XLA compile (BENCH_r05 cold numbers):
   (deploy-time priming), and ``GET /debug/compile`` serves
   :meth:`CachePrimer.snapshot` — the primed-vs-missing bucket view.
 
-Sharded (multi-chip lease) specs are recorded in the manifest but skipped
-by the primer (``skipped:sharded`` — the step executable is mesh-shaped;
-its cold path is covered by the warmup manifest once any job of that
-lease shape ran).  The ``sm_prime_*`` metric family is documented in
-docs/OBSERVABILITY.md.
+Sharded (multi-chip lease) specs prime too (ISSUE 14 — the follow-up
+PR 13 left): a recorded mesh-shaped spec carries its full lease topology
+(mesh axes, per-shard pixel capacity, every host-plan shape), so
+:func:`prime_spec` rebuilds the byte-identical ``jit(shard_map(step))``
+program over a mesh of the first ``devices`` local chips and AOT-compiles
+it — including the SHRUNKEN meshes a post-quarantine re-lease produces,
+which record their own topology-keyed spec at first dispatch and are warm
+for every later job of that lease shape.  A host with fewer visible
+devices than the mesh skips the spec (``skipped:devices``); legacy
+manifest entries recorded before the topology fields exist skip as
+``skipped:legacy_spec``.  The ``sm_prime_*`` metric family is documented
+in docs/OBSERVABILITY.md.
 """
 
 from __future__ import annotations
@@ -54,8 +61,9 @@ COMPILE_SURFACE = compile_surface(__name__, {
     "prime_spec":
         "statics=closure(recorded BucketSpec statics); buckets=the "
         "ops/buckets lattice itself — the primer only ever compiles "
-        "specs the backends recorded, so its surface is a subset of "
-        "models/msm_jax's",
+        "specs the backends recorded (flat AND mesh-shaped sharded, "
+        "keyed on lease topology), so its surface is a subset of "
+        "models/msm_jax's plus parallel/sharded's",
 })
 
 
@@ -99,6 +107,65 @@ def _flat_lower_call(spec: dict):
     return fn, args, statics
 
 
+def _sharded_lower_call(spec: dict):
+    """(jitted mesh step, positional sharded ShapeDtypeStruct avals) for
+    one recorded sharded spec — the exact calling convention of
+    ``ShardedJaxBackend._dispatch`` for that variant, rebuilt over a mesh
+    of the first ``spec['devices']`` local chips (the pool hands leases
+    out host-major, so the primed assignment matches the common case)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import FORMULAS_AXIS, PIXELS_AXIS
+    from ..parallel.sharded import build_sharded_score_factory
+
+    n_dev = int(spec["devices"])
+    pix, form = int(spec["mesh_pix"]), int(spec["mesh_form"])
+    mesh = Mesh(
+        np.array(jax.local_devices()[:n_dev]).reshape(pix, form),
+        (PIXELS_AXIS, FORMULAS_AXIS))
+    make = build_sharded_score_factory(
+        mesh,
+        p_loc=int(spec["p_loc"]),
+        nrows=int(spec["nrows"]), ncols=int(spec["ncols"]),
+        nlevels=int(spec["nlevels"]),
+        do_preprocessing=bool(spec["do_preprocessing"]),
+        q=float(spec["q"]))
+    n_keep, w_cap = int(spec["n_keep"]), int(spec["w_cap"])
+    fn = make(int(spec["gc_width"]), n_keep, w_cap)
+    i32, f32 = np.int32, np.float32
+    n, b, k = int(spec["n_resident"]), int(spec["b"]), int(spec["k"])
+    g, c = int(spec["g"]), int(spec["c"])
+    wc, w = int(spec["wc"]), int(spec["w"])
+    r_pad = int(spec["r_pad"])
+
+    def S(shape, dtype, part):
+        return jax.ShapeDtypeStruct(
+            shape, dtype, sharding=NamedSharding(mesh, part))
+
+    # run/band plan blocks mirror ShardedJaxBackend._dispatch: compact
+    # ships (S, F*r_pad) run lists, band/plain ship (S, F) dummies/starts
+    rp_w = form * r_pad if n_keep else form
+    args = [
+        S((pix, n), i32, P(PIXELS_AXIS, None)),            # px_s
+        S((pix, n), f32, P(PIXELS_AXIS, None)),            # in_s
+        S((pix, g), i32, P(PIXELS_AXIS, FORMULAS_AXIS)),   # pos
+        S((c,), i32, P(FORMULAS_AXIS)),                    # starts
+        S((c, wc), i32, P(FORMULAS_AXIS, None)),           # r_lo_loc
+        S((c, wc), i32, P(FORMULAS_AXIS, None)),           # r_hi_loc
+        S((w,), i32, P(FORMULAS_AXIS)),                    # inv
+        S((b, k), f32, P(FORMULAS_AXIS, None)),            # theor_ints
+        S((b,), i32, P(FORMULAS_AXIS)),                    # n_valid
+        S((pix, rp_w), i32, P(PIXELS_AXIS, FORMULAS_AXIS)),  # run_pos
+        S((pix, rp_w), i32, P(PIXELS_AXIS, FORMULAS_AXIS)),  # run_delta
+        S((pix, form), i32, P(PIXELS_AXIS, FORMULAS_AXIS)),  # n_b
+        S((1,), i32, P(None)),                             # n_real
+    ]
+    return fn, args
+
+
 def prime_spec(spec: dict, sm_config=None) -> str:
     """AOT-compile one recorded BucketSpec into the persistent XLA cache.
     Returns ``"compiled"`` or ``"skipped:<reason>"``; raises on a real
@@ -106,8 +173,9 @@ def prime_spec(spec: dict, sm_config=None) -> str:
 
     ``sm_config`` (when given) points the persistent cache first —
     without a cache dir the compile would only warm this process."""
-    if spec.get("kind") != "flat":
-        return f"skipped:{spec.get('kind', 'unknown')}"
+    kind = spec.get("kind")
+    if kind not in ("flat", "sharded"):
+        return f"skipped:{kind or 'unknown'}"
     if sm_config is not None:
         from ..parallel.distributed import compile_cache_path, enable_compile_cache
 
@@ -117,6 +185,20 @@ def prime_spec(spec: dict, sm_config=None) -> str:
             # XLA's cache writer skips (with a warning) when the dir is
             # missing — a primed-into-nothing cycle would claim success
             Path(cache_dir).mkdir(parents=True, exist_ok=True)
+    if kind == "sharded":
+        # topology-keyed mesh specs (ISSUE 14): skip gracefully where the
+        # host cannot hold the mesh, or the entry predates the fields
+        if any(spec.get(key) in (None, "None", "", 0)
+               for key in ("mesh_pix", "mesh_form", "p_loc", "w", "k", "g",
+                           "c", "wc")):
+            return "skipped:legacy_spec"  # pre-topology manifest entry
+        import jax
+
+        if jax.local_device_count() < int(spec["devices"]):
+            return "skipped:devices"
+        fn, args = _sharded_lower_call(spec)
+        fn.lower(*args).compile()
+        return "compiled"
     fn, args, statics = _flat_lower_call(spec)
     fn.lower(*args, **statics).compile()
     return "compiled"

@@ -71,12 +71,14 @@ from ..engine.daemon import (
     clear_heartbeat,
     sweep_orphan_tmp,
 )
+from ..models import faults
 from ..utils import tracing
 from ..utils.cancel import CancelToken, DeadlineExceededError, JobCancelledError
 from ..utils.config import ServiceConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
 from .device_pool import DevicePool, resolve_pool_size
+from .health import HealthTracker
 from .leases import (
     FP_TAKEOVER_SCAN,
     FenceRejectedError,
@@ -321,10 +323,20 @@ class JobScheduler:
         # pool still speaks the old single-token Lock protocol, and
         # ``device_token`` stays as the back-compat alias for code that
         # poked the PR 1 lock directly.
-        self.device_pool = device_pool if device_pool is not None else \
-            DevicePool(resolve_pool_size(self.cfg),
-                       max_bypass=self.cfg.device_pool_max_bypass,
-                       hosts=self.cfg.device_pool_hosts)
+        if device_pool is not None:
+            self.device_pool = device_pool
+        else:
+            size = resolve_pool_size(self.cfg)
+            self.device_pool = DevicePool(
+                size, max_bypass=self.cfg.device_pool_max_bypass,
+                hosts=self.cfg.device_pool_hosts,
+                health=HealthTracker.from_config(
+                    size, self.cfg, hosts=self.cfg.device_pool_hosts))
+        # classified device faults from the scoring seam reach the pool's
+        # health tracker through the models-side listener seam (ISSUE 14,
+        # models/faults.py) — quarantine/probe verdicts then shape every
+        # later grant, incl. this scheduler's retry re-lease
+        faults.set_fault_listener(self.device_pool.health)
         self.device_token = self.device_pool
         # multi-replica protocol (ISSUE 8, service/leases.py): this
         # replica's identity in the registry, its epoch-numbered fenced
@@ -899,6 +911,14 @@ class JobScheduler:
                 span_id=attempt_trace.span_id, parent_id=root.span_id,
                 attempt=rec.attempts, timed_out=bool(timed_out),
                 abandoned=bool(abandoned))
+            # the attempt is over (or abandoned): stop the claim heartbeat
+            # BEFORE any terminal outcome, so an in-flight renewal can
+            # never re-create the fenced lease file after _drop_lease
+            # clears it (the outcome writes are fence-gated — the
+            # heartbeat only informs staleness, and the write window is
+            # far inside the staleness horizon)
+            hb.stop()
+            hb = None
             if self.metrics:
                 self.m_duration.observe(dt)
             if self.admission is not None:
@@ -1538,6 +1558,9 @@ class JobScheduler:
         # drop out of the registry so peers adopt our shards immediately
         # instead of waiting out the staleness horizon
         self.registry.retire()
+        # detach the fault listener only if it is still ours — a newer
+        # scheduler's registration (tests build many per process) survives
+        faults.clear_fault_listener(self.device_pool.health)
         if self.metrics:
             self.m_replica_up.labels(replica=self.replica_id).set(0)
         logger.info("scheduler: shutdown %s", "clean" if ok else "TIMED OUT")

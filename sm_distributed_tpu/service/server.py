@@ -17,7 +17,7 @@ import time
 from pathlib import Path
 
 from ..engine.daemon import QUEUE_ANNOTATE, QueuePublisher, _STATES
-from ..models import oom
+from ..models import faults, oom
 from ..models.breaker import attach_metrics as attach_breaker_metrics
 from ..models.breaker import get_device_breaker
 from ..utils import tracing
@@ -73,10 +73,19 @@ class AnnotationService:
         # backend so a jax_tpu service leases out every visible chip, while
         # a numpy_ref service keeps the degenerate 1-chip pool (= the old
         # single-token serialization)
+        pool_size = resolve_pool_size(cfg, backend=self.sm_config.backend)
+        # per-chip health (ISSUE 14, service/health.py): quarantined chips
+        # leave placement, lease-time probes fence dead chips before a job
+        # touches them, half-open re-probes readmit recovered ones —
+        # surfaced on GET /debug/devices and sm_device_* metrics
+        from .health import HealthTracker
+
         self.device_pool = DevicePool(
-            resolve_pool_size(cfg, backend=self.sm_config.backend),
+            pool_size,
             max_bypass=cfg.device_pool_max_bypass,
-            hosts=cfg.device_pool_hosts)
+            hosts=cfg.device_pool_hosts,
+            health=HealthTracker.from_config(
+                pool_size, cfg, hosts=cfg.device_pool_hosts))
         self.device_pool.attach_metrics(self.metrics)
         # resource governor (ISSUE 10, service/resources.py): disk-budget
         # preflight at every governed write seam, degrade order traces →
@@ -99,6 +108,9 @@ class AnnotationService:
         # HBM-OOM adaptive-scoring telemetry (models/oom.py): events,
         # converged backoffs, and the learned safe batch on /metrics
         oom.attach_metrics(self.metrics)
+        # classified device-fault telemetry (models/faults.py, ISSUE 14):
+        # sm_device_faults_total{kind=} beside the oom/breaker families
+        faults.attach_metrics(self.metrics)
         # compile-retrace attribution (ISSUE 12, analysis/retrace.py):
         # every XLA compilation this process pays for is attributed to its
         # call site + abstract signature (sm_compile_* on /metrics, a

@@ -165,6 +165,17 @@ class DeviceMonitor:
         locked = None
         pool_snap = None
         if self.device_pool is not None:
+            # half-open device recovery (ISSUE 14): quarantined chips past
+            # their cooldown are re-probed on the sampling cadence too, so
+            # an idle service readmits recovered chips without waiting for
+            # the next lease to trigger it
+            health = getattr(self.device_pool, "health", None)
+            if health is not None:
+                try:
+                    health.reprobe_due()
+                except Exception:
+                    logger.warning("telemetry: device re-probe failed",
+                                   exc_info=True)
             # per-chip pool occupancy (ISSUE 7 satellite): the pool updates
             # its own sm_device_pool_in_use{device=} gauge at grant/release
             # (event-exact); here we sample the pool-WIDE ratio into the
@@ -218,6 +229,19 @@ class DeviceMonitor:
                 pool_snap["in_use"] / max(1, pool_snap["size"]), 4)
             snap["device_pool_waiters"] = pool_snap["waiters"]
             snap["device_pool_grants_total"] = pool_snap["grants_total"]
+            # chip-level health roll-up (ISSUE 14, service/health.py):
+            # state counts + the fenced chip list, so /debug/timeseries
+            # shows quarantines/readmits as a trend without scraping
+            health = pool_snap.get("health")
+            if health is not None:
+                snap["device_health_ok"] = health["ok"]
+                snap["device_health_suspect"] = health["suspect"]
+                snap["device_health_quarantined"] = health["quarantined"]
+                snap["device_quarantined"] = [
+                    c["device"] for c in health["chips"]
+                    if c["state"] == "quarantined"]
+                snap["device_quarantines_total"] = (
+                    health["quarantines_total"])
         if self.queue_root is not None:
             try:
                 snap["queue_pending"] = len(

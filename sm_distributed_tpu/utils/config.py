@@ -361,6 +361,23 @@ class ServiceConfig:
                                          # its thread exits, or forcibly
                                          # after this TTL; 0 = wait for the
                                          # thread forever
+    # --- per-chip device health (service/health.py, ISSUE 14) ---
+    health_probe_on_lease: bool = True   # probe every granted chip with a
+                                         # device round-trip before the job
+                                         # touches it (no-op without jax)
+    health_fault_quarantine: int = 3     # consecutive transient /
+                                         # unattributed-sticky strikes on a
+                                         # chip before it is quarantined
+                                         # (an attributed sticky fault
+                                         # quarantines immediately)
+    health_reprobe_after_s: float = 60.0 # quarantine -> half-open re-probe
+                                         # cooldown; a passing re-probe
+                                         # readmits the chip (0 = never
+                                         # re-probe)
+    health_host_evict_fraction: float = 0.75  # fraction of a host domain's
+                                         # chips quarantined at which the
+                                         # WHOLE host is evicted (>= 1.0
+                                         # disables host eviction)
     # --- multi-replica scheduling (service/leases.py, ISSUE 8) ---
     replica_id: str = "r0"               # this scheduler process's identity
                                          # (serve --replica-id); leases and
@@ -405,6 +422,13 @@ class ServiceConfig:
         if self.device_pool_hosts <= 0 or self.lease_reap_after_s < 0:
             raise ValueError("service: device_pool_hosts must be >= 1 and "
                              "lease_reap_after_s >= 0")
+        if self.health_fault_quarantine < 1 or \
+                self.health_reprobe_after_s < 0 or \
+                not 0.0 < self.health_host_evict_fraction:
+            raise ValueError(
+                "service: health_fault_quarantine must be >= 1, "
+                "health_reprobe_after_s >= 0, and "
+                "health_host_evict_fraction > 0 (>= 1.0 disables eviction)")
         if not self.replica_id or self.replicas <= 0 or self.spool_shards <= 0:
             raise ValueError("service: replica_id must be non-empty and "
                              "replicas/spool_shards positive")

@@ -63,6 +63,18 @@ def _reset_device_breaker():
 
 
 @pytest.fixture(autouse=True)
+def _reset_fault_listener():
+    """Isolate the device-fault listener seam (models/faults.py): a
+    scheduler built by one test must not keep routing fault reports into
+    its (long-gone) pool's health tracker during later tests."""
+    from sm_distributed_tpu.models import faults
+
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(autouse=True)
 def _reset_oom_registry():
     """Isolate the OOM safe-batch memory (models/oom.py): a learned batch
     from one test must not silently shrink every later search on the same

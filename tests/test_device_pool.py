@@ -357,3 +357,97 @@ def test_pool_metrics_exposition(tmp_path):
     text = m.expose()
     assert 'sm_device_pool_in_use{device="0"} 0' in text
     assert "sm_device_pool_wait_seconds_count 1" in text
+
+
+# ------------------------------------------ quarantine fragmentation (ISSUE 14)
+def _quarantine(pool, *chips):
+    for c in chips:
+        assert pool.health._quarantine(c, "test quarantine")
+
+
+def test_pool_fragmented_by_quarantine_grants_non_contiguous():
+    """Quarantine chips 2 and 5 of 8: the longest healthy contiguous run
+    is 2 chips, yet a 4-chip lease must still grant — non-contiguous,
+    from the free healthy chips, warned rather than waiting forever."""
+    pool = DevicePool(8)
+    _quarantine(pool, 2, 5)
+    lease = pool.lease(4, "frag")
+    assert lease.acquire(timeout=2)
+    assert list(lease.devices) == [0, 1, 3, 4]      # host-major free picks
+    assert 2 not in lease.devices and 5 not in lease.devices
+    # a second 4-chip lease WAITS (6 healthy chips exist — busy is not
+    # quarantined; only quarantine shrinks a request), then grants
+    # non-contiguous once the first releases
+    other = pool.lease(4, "frag2")
+    assert not other.acquire(timeout=0.05)
+    lease.release()
+    assert other.acquire(timeout=2)
+    assert list(other.devices) == [0, 1, 3, 4]
+    other.release()
+    assert pool.in_use_count() == 0
+
+
+def test_pool_healthy_but_busy_still_waits_contiguous():
+    """Without quarantine the legacy semantics are untouched: a pool
+    fragmented only by BUSY leases waits for a contiguous run instead of
+    granting a scattered one."""
+    pool = DevicePool(4)
+    mid = pool.lease(1, "mid")
+    assert mid.acquire(timeout=1)
+    # occupy chip 1 specifically: grab 0-1 then free 0
+    a = pool.lease(1, "a")
+    assert a.acquire(timeout=1)
+    assert set(mid.devices) | set(a.devices) == {0, 1}
+    big = pool.lease(3, "big")
+    assert not big.acquire(timeout=0.05), \
+        "3-chip lease must wait for a contiguous run, not scatter"
+    big.release()
+    mid.release(), a.release()
+
+
+def test_pool_fairness_and_bypass_hold_under_quarantine():
+    """FIFO-ish fairness and the bypass budget still hold on the shrunken
+    pool: a starved larger waiter seals the queue exactly as before."""
+    pool = DevicePool(4, max_bypass=0)
+    _quarantine(pool, 3)
+    hold = pool.lease(1, "hold")
+    assert hold.acquire(timeout=1)
+    big = pool.lease(3, "big")                       # needs all 3 healthy
+    assert not big.acquire(timeout=0.02)
+    late = pool.lease(1, "late")
+    assert not late.acquire(timeout=0.05), "queue not sealed behind big"
+    hold.release()
+    assert big.acquire(timeout=5)
+    assert len(big.devices) == 3 and 3 not in big.devices
+    big.release()
+    assert late.acquire(timeout=5)
+    late.release()
+
+
+def test_pool_release_and_reap_idempotent_with_quarantine():
+    """Release/reap stay idempotent when quarantine shrank the pool, and
+    a quarantined chip never re-enters circulation through release."""
+    pool = DevicePool(4)
+    lease = pool.lease(4, "all")
+    assert lease.acquire(timeout=1)
+    assert len(lease.devices) == 4
+    pool.health._quarantine(2, "went sticky while held")
+    lease.release()
+    lease.release()                                  # idempotent
+    pool.reap(lease)                                 # no-op after release
+    nxt = pool.lease(4, "next")
+    assert nxt.acquire(timeout=2)
+    assert 2 not in nxt.devices and len(nxt.devices) == 3
+    nxt.release()
+    assert pool.in_use_count() == 0 and pool.waiters() == 0
+
+
+def test_pool_never_quarantines_last_healthy_chip():
+    pool = DevicePool(2)
+    assert pool.health._quarantine(0, "bad")
+    assert not pool.health._quarantine(1, "bad"), \
+        "the last healthy chip must never be fenced"
+    lease = pool.lease(2, "survivor")
+    assert lease.acquire(timeout=1)
+    assert list(lease.devices) == [1]
+    lease.release()
