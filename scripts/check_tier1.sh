@@ -77,6 +77,22 @@ if ! env JAX_PLATFORMS=cpu python scripts/perf_sentinel.py \
     exit 1
 fi
 
+# ULP-contract numerics sentinel (ISSUE 15): score the off-lattice
+# spheroid fixture on the lattice-bucketed jax backend AND the numpy
+# oracle — FDR-rank identity is a HARD gate, per-MSM-component max-ULP
+# drift must stay inside the declared COMPONENT_CONTRACTS ceilings, and
+# the drift is band-checked against the committed NUMERICS_r*.json
+# history (rising drift regresses).  This is the correctness backstop
+# for ROADMAP item 3's bf16/int8 compaction work.
+if ! env JAX_PLATFORMS=cpu python scripts/ulp_sentinel.py; then
+    echo "check_tier1: FAIL — ULP-contract numerics sentinel tripped" >&2
+    exit 1
+fi
+if ! env JAX_PLATFORMS=cpu python scripts/ulp_sentinel.py --self-check; then
+    echo "check_tier1: FAIL — ulp_sentinel self-check failed" >&2
+    exit 1
+fi
+
 # compile census gate (ISSUE 12): the spheroid fixture through the real
 # service on the jax backend — every XLA compilation attributed to a
 # COMPILE_SURFACE-registered call site, the signature set closed under a

@@ -122,9 +122,23 @@ def normalize(data: dict) -> dict[str, tuple[float, str]]:
                 out[f"analysis.findings.{rule}"] = (v, "down")
         for key in ("sm_compile_surface_sites_total",
                     "sm_compile_surface_entries_total",
-                    "sm_compile_surface_modules_total"):
+                    "sm_compile_surface_modules_total",
+                    # ISSUE 15: the numerics-contract census rides the same
+                    # drift series — a rising violation count is lint debt,
+                    # a quietly growing contract surface is reviewable drift
+                    "sm_numerics_contracts_total",
+                    "sm_numerics_violations_total"):
             if (v := _num(data.get(key))) is not None:
                 out[f"analysis.{key[len('sm_'):]}"] = (v, "down")
+    elif "sm_numerics_max_ulp" in data:               # ulp_sentinel (ISSUE 15)
+        # per-MSM-component max-ULP drift vs the numpy oracle: RISING
+        # drift regresses (the ulp-contract gate for ROADMAP item 3's
+        # bf16/int8 compaction); rank mismatches are a hard 0
+        for comp, v in (data.get("sm_numerics_max_ulp") or {}).items():
+            if (v := _num(v)) is not None:
+                out[f"numerics.max_ulp.{comp}"] = (v, "down")
+        if (v := _num(data.get("fdr_rank_mismatches"))) is not None:
+            out["numerics.fdr_rank_mismatches"] = (v, "down")
     return out
 
 

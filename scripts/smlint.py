@@ -133,9 +133,20 @@ def main(argv: list[str] | None = None) -> int:
         errs = self_check(project, baseline)
 
     if args.as_json:
-        from sm_distributed_tpu.analysis.rules import compile_surface_census
+        from sm_distributed_tpu.analysis.rules import (
+            compile_surface_census,
+            numerics_census,
+        )
 
         surface = compile_surface_census(project)
+        ncensus = numerics_census(project)
+        # numlint totals (ISSUE 15): declared contracts + all findings of
+        # the three numerics rules (INCLUDING baseline-suppressed ones),
+        # so the analysis drift sentinel bands numerics debt like any
+        # other rule-count series
+        all_counts = result.counts("all")
+        nviol = sum(all_counts.get(r, 0) for r in
+                    ("dtype-flow", "masked-reduction", "ulp-contract"))
         print(json.dumps({
             "paths": list(args.paths) or list(DEFAULT_PATHS),
             "files": len(project.modules),
@@ -152,6 +163,9 @@ def main(argv: list[str] | None = None) -> int:
             "sm_compile_surface_sites_total": surface["sites"],
             "sm_compile_surface_entries_total": surface["entries"],
             "sm_compile_surface_modules_total": surface["modules"],
+            "sm_numerics_contracts_total": ncensus["contracts"],
+            "sm_numerics_modules_total": ncensus["modules"],
+            "sm_numerics_violations_total": nviol,
         }, indent=2))
     else:
         for f in result.new:

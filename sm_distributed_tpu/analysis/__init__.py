@@ -11,11 +11,19 @@ its Postgres/ES bookkeeping — and multi-replica scale-out multiplies the
 cost of a miss: an unfenced write becomes a cross-replica double-commit, a
 lock-order cycle a fleet-wide deadlock.
 
-Four parts:
+Six parts:
 
 - ``core`` + ``rules`` — a stdlib-``ast`` lint framework (rule registry,
   per-rule severity, committed suppression baseline, per-rule firing
   fixtures) behind the ``scripts/smlint.py`` CLI.  Docs: docs/ANALYSIS.md.
+- ``dataflow`` — the shared forward-dataflow/taint engine (ISSUE 15):
+  per-function walks, source/sanitizer taint tracking, def-use chains,
+  single-level call summaries; ``fence-gate``, ``retrace-hazard``,
+  ``dtype-flow`` and ``masked-reduction`` all ride it.
+- ``numerics`` — the declarative ``NUMERICS`` contract registry
+  (``contract=bit_exact|ulp(N)`` + proving test + padded operands) and
+  the float32 ULP measurement helpers behind
+  ``scripts/ulp_sentinel.py``'s committed-drift gate.
 - ``lockorder`` — opt-in runtime instrumentation of ``threading.Lock`` /
   ``RLock`` / ``Condition`` ("tsan-lite") that records the lock
   acquisition-order graph across scheduler / device-pool / admission /

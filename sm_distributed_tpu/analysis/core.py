@@ -30,10 +30,19 @@ from pathlib import Path
 SEVERITIES = ("error", "warning")
 
 _IGNORE_RE = re.compile(r"#\s*smlint:\s*ignore\[([a-z0-9_,\- ]+)\]")
-# host-sync annotation (ISSUE 12): `# smlint: host-sync-ok[reason]` marks a
-# deliberate device->host synchronization in a hot scoring module; the
-# REASON is mandatory — the annotation is an argument, not a mute button
-_HOST_SYNC_RE = re.compile(r"#\s*smlint:\s*host-sync-ok\[([^\]]*)\]")
+# reasoned `-ok` annotations: `# smlint: <kind>-ok[reason]` marks a
+# deliberate instance of a flagged pattern — a device->host sync (ISSUE
+# 12), a dtype escape or a pad-axis reduction (ISSUE 15).  The REASON is
+# mandatory in every case — the annotation is an argument, not a mute
+# button — and each rule treats an empty reason as a finding.
+_ANNOT_RES: dict[str, re.Pattern] = {}
+
+
+def _annot_re(kind: str) -> re.Pattern:
+    if kind not in _ANNOT_RES:
+        _ANNOT_RES[kind] = re.compile(
+            r"#\s*smlint:\s*" + re.escape(kind) + r"-ok\[([^\]]*)\]")
+    return _ANNOT_RES[kind]
 
 
 # ------------------------------------------------------------------ findings
@@ -120,15 +129,19 @@ class Module:
                 out |= {r.strip() for r in m.group(1).split(",") if r.strip()}
         return out
 
-    def host_sync_reason(self, lineno: int) -> str | None:
-        """The ``# smlint: host-sync-ok[reason]`` annotation on the line or
+    def annotation_reason(self, kind: str, lineno: int) -> str | None:
+        """The ``# smlint: <kind>-ok[reason]`` annotation on the line or
         the line above — None when unannotated, "" when the reason is
-        empty (the host-sync rule treats that as a violation too)."""
+        empty (rules treat an empty reason as a violation too)."""
+        pat = _annot_re(kind)
         for ln in (lineno, lineno - 1):
-            m = _HOST_SYNC_RE.search(self.line_text(ln))
+            m = pat.search(self.line_text(ln))
             if m:
                 return m.group(1).strip()
         return None
+
+    def host_sync_reason(self, lineno: int) -> str | None:
+        return self.annotation_reason("host-sync", lineno)
 
 
 class Project:

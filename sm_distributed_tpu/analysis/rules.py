@@ -16,6 +16,14 @@ Rules:
 - ``jit-compile-surface``— every jit/pjit/shard_map site declared in COMPILE_SURFACE
 - ``retrace-hazard``    — raw shapes/lengths can't flow into static args unbucketed
 - ``host-sync``         — device->host syncs in hot scoring modules are annotated
+- ``dtype-flow``        — implicit-promotion hazards in NUMERICS-declaring modules
+- ``masked-reduction``  — reductions over lattice-padded axes use the n_real helpers
+- ``ulp-contract``      — every compile-surface site declares a test-backed contract
+
+The local-variable taint walks (``fence-gate``, ``retrace-hazard``,
+``dtype-flow``, ``masked-reduction``) all ride the shared forward-dataflow
+engine in ``dataflow.py`` (ISSUE 15): one walker, per-rule source/
+sanitizer predicates, single-level call summaries.
 """
 
 from __future__ import annotations
@@ -23,8 +31,12 @@ from __future__ import annotations
 import ast
 import json
 import re
+import struct
 
+from . import dataflow
+from . import numerics as numerics_mod
 from .core import Finding, Project, rule
+from .dataflow import TaintTracker
 
 # findings are created with rule/severity placeholders; core.Rule.run stamps
 # the registered values over them
@@ -87,6 +99,13 @@ _FENCED_FAILPOINTS = {
 }
 # terminal-spool dirs whose writes are dead-letter/quarantine seams
 _TERMINAL_DIRS = ("failed", "quarantine")
+
+
+def _terminal_dir_source(node: ast.AST) -> bool:
+    """Taint source for the fence-gate walk: a string constant naming a
+    terminal spool directory (the same subtree-string test the rule's
+    original in-line walk applied to assignment RHSs)."""
+    return isinstance(node, ast.Constant) and node.value in _TERMINAL_DIRS
 # storage-layer commits gated at their CALL SITE (the storage module itself
 # is the layer below the fence; its callers own the guard)
 _GATED_CALLS = ("finish_job",)
@@ -165,15 +184,11 @@ def fence_gate(project: Project):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             guards: list[int] = []    # linenos of fence-guard calls
-            tainted: set[str] = set() # locals assigned from terminal-dir paths
             seams: list[tuple[ast.AST, str]] = []
-            for node in ast.walk(fn):
-                if mod.enclosing_function(node) is not fn and node is not fn:
-                    continue          # skip nested defs/lambdas
-                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name) and \
-                        _subtree_strs(node.value) & set(_TERMINAL_DIRS):
-                    tainted.add(node.targets[0].id)
+            # shared dataflow engine (ISSUE 15): locals assigned from
+            # expressions naming a terminal dir become tainted paths
+            taint = TaintTracker(source=_terminal_dir_source)
+            for node in taint.walk(mod, fn):
                 if not isinstance(node, ast.Call):
                     continue
                 callee = _call_name(node)
@@ -192,7 +207,7 @@ def fence_gate(project: Project):
                     recv = node.func.value
                     hit = _subtree_strs(recv) & set(_TERMINAL_DIRS)
                     if not hit and isinstance(recv, ast.Name) and \
-                            recv.id in tainted:
+                            recv.id in taint.names:
                         hit = {"(tainted path)"}
                     if hit:
                         seams.append(
@@ -200,7 +215,7 @@ def fence_gate(project: Project):
                 elif callee == "replace" and \
                         _attr_chain(node.func) == "os.replace" and any(
                             _subtree_strs(a) & set(_TERMINAL_DIRS) or (
-                                isinstance(a, ast.Name) and a.id in tainted)
+                                isinstance(a, ast.Name) and a.id in taint.names)
                             for a in node.args):
                     seams.append((node, "terminal-spool move"))
                 elif callee in _GATED_CALLS:
@@ -1021,18 +1036,6 @@ def _is_bucketing_call(node: ast.AST) -> bool:
             any(t in callee for t in ("bucket", "round", "pad")))
 
 
-def _expr_shape_taint(node: ast.AST, tainted: set[str]) -> bool:
-    """Does ``node`` carry a raw shape read (directly or through a tainted
-    local) that never passes a bucketing helper?"""
-    if any(_is_bucketing_call(n) for n in ast.walk(node)):
-        return False
-    return any(
-        _is_shape_source(n) or (
-            isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) and
-            n.id in tainted)
-        for n in ast.walk(node))
-
-
 @rule("retrace-hazard", severity="error",
       doc="Raw runtime-shape reads (.shape / .size / len()) must not flow "
           "into a jitted callable's static arguments (the kwarg names a "
@@ -1055,20 +1058,18 @@ def retrace_hazard(project: Project):
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            tainted: set[str] = set()
-            for node in ast.walk(fn):
-                if mod.enclosing_function(node) is not fn and node is not fn:
-                    continue
-                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                        and isinstance(node.targets[0], ast.Name) and \
-                        _expr_shape_taint(node.value, tainted):
-                    tainted.add(node.targets[0].id)
+            # shared dataflow engine (ISSUE 15): raw shape reads taint
+            # locals; ONE bucketing call anywhere in an expression
+            # sanitizes the whole expression (the legacy flat contract)
+            taint = TaintTracker(source=_is_shape_source,
+                                 sanitizer=_is_bucketing_call)
+            for node in taint.walk(mod, fn):
                 if not isinstance(node, ast.Call):
                     continue
                 for kw in node.keywords:
                     if kw.arg not in static_names:
                         continue
-                    if _expr_shape_taint(kw.value, tainted):
+                    if taint.expr_tainted(kw.value):
                         yield _finding(
                             mod, node,
                             f"static argument {kw.arg!r} receives a raw "
@@ -1259,3 +1260,530 @@ def broad_except(project: Project):
                     "broad except swallows the exception without logging, "
                     "re-raising, or recording it — narrow the type or add "
                     "context (trace/job id) to a log line")
+
+
+# ============================================================ 11. dtype-flow
+# Implicit-promotion hazards in the NUMERICS-declaring (jitting) modules
+# (ISSUE 15): a dtype-less jnp constructor mints a weak/x64-dependent
+# dtype, a float64 value flowing into a jnp op silently promotes the
+# declared-f32 graph (and flips ULP behavior the committed contracts
+# pin), and a non-f32-exact bare float literal inside a jnp call changes
+# value the moment someone flips jax_enable_x64.  Deliberate escapes are
+# annotated `# smlint: dtype-ok[reason]`.
+_JNP_CONSTRUCTORS = {
+    # name -> positional index where dtype may legally appear (None =
+    # keyword-only, because the positional form is ambiguous)
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2, "asarray": 1, "array": 1,
+    "arange": None, "linspace": None, "eye": None,
+}
+_DTYPE_CAST_NAMES = ("float32", "float16", "bfloat16", "int8", "int16",
+                     "int32", "int64", "uint8", "uint32", "bool_",
+                     "float64", "double")
+_F64_NAMES = ("float64", "double")
+
+
+def _jnp_chain(chain: str) -> bool:
+    """Is ``chain`` a jax-numpy/lax callable path (jnp.*, lax.*, jax.*)?"""
+    root = chain.split(".")[0]
+    return root in ("jnp", "lax") or chain.startswith("jax.")
+
+
+def _numerics_decl(mod) -> tuple[dict[str, tuple[str, int]] | None, int]:
+    """The module's ``NUMERICS = numerics_surface(_, {...})`` declaration:
+    ({site: (policy, lineno)}, decl lineno), or (None, 0) — the exact
+    mirror of ``_surface_decl``."""
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1 and
+                isinstance(node.targets[0], ast.Name) and
+                node.targets[0].id == "NUMERICS"):
+            continue
+        if not (isinstance(node.value, ast.Call) and
+                _call_name(node.value) == "numerics_surface" and
+                len(node.value.args) >= 2 and
+                isinstance(node.value.args[1], ast.Dict)):
+            return {}, node.lineno    # declared but not the literal grammar
+        out = {}
+        for k, v in zip(node.value.args[1].keys, node.value.args[1].values):
+            ks, vs = _const_str(k), _const_str(v)
+            if ks is not None:
+                out[ks] = (vs or "", getattr(k, "lineno", node.lineno))
+        return out, node.lineno
+    return None, 0
+
+
+def _f32_exact(v: float) -> bool:
+    """Is ``v`` exactly representable in float32 (so its value is
+    identical at every promotion width)?"""
+    try:
+        return struct.unpack("f", struct.pack("f", v))[0] == v
+    except (OverflowError, struct.error):
+        return False
+
+
+def _is_f64_dtype_expr(e: ast.AST) -> bool:
+    """``np.float64`` / ``jnp.float64`` / ``"float64"`` / bare ``float``
+    used as a dtype value."""
+    chain = _attr_chain(e)
+    if chain.split(".")[-1] in _F64_NAMES:
+        return True
+    if isinstance(e, ast.Name) and e.id == "float":
+        return True
+    return _const_str(e) in ("float64", "double")
+
+
+def _f64_source(node: ast.AST) -> bool:
+    """Taint source for the f64-flow walk: a ``np.float64``/``np.double``
+    scalar mint, an ``.astype(float64-ish)`` cast, or any call carrying a
+    ``dtype=float64-ish`` keyword."""
+    if not isinstance(node, ast.Call):
+        return False
+    callee = _call_name(node)
+    if callee in _F64_NAMES and \
+            _attr_chain(node.func).split(".")[0] in ("np", "numpy", "jnp"):
+        return True
+    if callee == "astype" and node.args and _is_f64_dtype_expr(node.args[0]):
+        return True
+    return any(kw.arg == "dtype" and _is_f64_dtype_expr(kw.value)
+               for kw in node.keywords)
+
+
+_DF_FIXTURE_FAIL = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from ..analysis.numerics import numerics_surface\n"
+        "NUMERICS = numerics_surface(__name__, {\n"
+        "    'score': 'contract=ulp(4); test=tests/test_x.py::test_score',\n"
+        "})\n"
+        "def score(x):\n"
+        "    idx = jnp.arange(x.shape[0])\n"
+        "    w = np.float64(0.5)\n"
+        "    y = jnp.where(x > 0, x * 1e-30, 0.0)\n"
+        "    return jnp.sum(y * w) + idx\n"
+    ),
+}
+_DF_FIXTURE_PASS = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from ..analysis.numerics import numerics_surface\n"
+        "NUMERICS = numerics_surface(__name__, {\n"
+        "    'score': 'contract=ulp(4); test=tests/test_x.py::test_score',\n"
+        "})\n"
+        "def score(x):\n"
+        "    idx = jnp.arange(x.shape[0], dtype=jnp.int32)\n"
+        "    w = np.float32(0.5)\n"
+        "    y = jnp.where(x > 0, x * np.float32(1e-30), 0.0)\n"
+        "    # smlint: dtype-ok[f64 epilogue runs on host after the fetch]\n"
+        "    z = jnp.asarray(np.float64(2.0), dtype=jnp.float32)\n"
+        "    return jnp.sum(y * w) * z + idx\n"
+    ),
+}
+
+
+@rule("dtype-flow", severity="error",
+      doc="In NUMERICS-declaring (jitting) modules: jnp constructors "
+          "(zeros/ones/full/arange/asarray/...) must pass an explicit "
+          "dtype (a dtype-less constructor mints a weak/x64-dependent "
+          "type); float64 values (np.float64/np.double mints, "
+          ".astype(float64), dtype=float64 kwargs) must not flow into "
+          "jnp/lax calls — tracked through locals and single-level call "
+          "summaries by the shared dataflow engine; and non-f32-exact "
+          "bare float literals inside jnp/lax call arguments must be "
+          "wrapped in an explicit dtype cast.  Deliberate escapes carry "
+          "`# smlint: dtype-ok[reason]` (empty reason = finding).",
+      fixture_fail=_DF_FIXTURE_FAIL, fixture_pass=_DF_FIXTURE_PASS)
+def dtype_flow(project: Project):
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue
+        decl, _ = _numerics_decl(mod)
+        if decl is None:
+            continue                  # not a declared-precision module
+
+        def annotated(node) -> tuple[bool, bool]:
+            """(skip, empty_reason) for the dtype-ok annotation."""
+            reason = mod.annotation_reason("dtype", node.lineno)
+            return reason is not None and reason != "", reason == ""
+
+        # (a) dtype-less jnp constructors + (c) non-exact bare literals
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                callee = _call_name(node)
+                if _jnp_chain(chain) and callee in _JNP_CONSTRUCTORS:
+                    pos = _JNP_CONSTRUCTORS[callee]
+                    has_dtype = any(kw.arg == "dtype"
+                                    for kw in node.keywords) or (
+                        pos is not None and len(node.args) > pos)
+                    if not has_dtype:
+                        ok, empty = annotated(node)
+                        if ok:
+                            continue
+                        yield _finding(
+                            mod, node,
+                            f"dtype-less jnp.{callee}() in a declared-"
+                            f"precision module mints a weak/x64-dependent "
+                            f"dtype — pass dtype= explicitly or annotate "
+                            f"`# smlint: dtype-ok[reason]`"
+                            + (" (annotation reason is empty)" if empty
+                               else ""))
+                continue
+            if not (isinstance(node, ast.Constant) and
+                    isinstance(node.value, float)):
+                continue
+            if _f32_exact(node.value):
+                continue              # value identical at every width
+            in_jnp, sanitized = False, False
+            for anc in mod.ancestors(node):
+                if isinstance(anc, ast.Call):
+                    if _call_name(anc) in _DTYPE_CAST_NAMES:
+                        sanitized = True   # np.float32(lit): explicit width
+                        break
+                    if _jnp_chain(_attr_chain(anc.func)):
+                        in_jnp = True
+                        break
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    break
+            if in_jnp and not sanitized:
+                ok, empty = annotated(node)
+                if ok:
+                    continue
+                yield _finding(
+                    mod, node,
+                    f"bare float literal {node.value!r} is not exactly "
+                    f"representable in float32 but rides a jnp/lax call — "
+                    f"its weak-f64 value changes under jax_enable_x64; "
+                    f"wrap it in np.float32(...) or annotate "
+                    f"`# smlint: dtype-ok[reason]`"
+                    + (" (annotation reason is empty)" if empty else ""))
+        # (b) float64 values flowing into jnp/lax calls (dataflow taint,
+        # single-level call summaries)
+        summaries = dataflow.summaries.get(mod)
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            taint = TaintTracker(source=_f64_source, summaries=summaries)
+            for node in taint.walk(mod, fn):
+                if not isinstance(node, ast.Call) or \
+                        not _jnp_chain(_attr_chain(node.func)):
+                    continue
+                parts = list(node.args) + [kw.value for kw in node.keywords]
+                mints_f64 = any(kw.arg == "dtype" and
+                                _is_f64_dtype_expr(kw.value)
+                                for kw in node.keywords)
+                if not (mints_f64 or
+                        any(taint.expr_tainted(p) for p in parts)):
+                    continue
+                ok, empty = annotated(node)
+                if ok:
+                    continue
+                yield _finding(
+                    mod, node,
+                    f"a float64 value flows into {_attr_chain(node.func) or _call_name(node)}() "
+                    f"in a declared-f32 jitting module — the implicit "
+                    f"promotion silently changes the graph's precision; "
+                    f"cast to the declared dtype first or annotate "
+                    f"`# smlint: dtype-ok[reason]`"
+                    + (" (annotation reason is empty)" if empty else ""))
+
+
+# ======================================================= 12. masked-reduction
+# PR 13's shape-bucket lattice pads pixel rows and resident peaks; any
+# reduction over an axis carrying that padding that skips the n_real
+# masked helpers (batch_metrics(n_real=) / ops/moments_pallas.batch_
+# moments family) produces wrong-but-plausible metrics.  Taint enters a
+# function through parameters the NUMERICS entry declares `padded=` and
+# through ops/buckets padding-helper calls; raw reductions over tainted
+# values fire unless annotated `# smlint: masked-ok[reason]` (the
+# argument why THIS reduction is pad-invariant).
+_MASKED_HELPERS = ("batch_metrics", "batch_moments", "batch_moments_jnp",
+                   "batch_moments_pallas_masked")
+_REDUCTION_METHODS = ("sum", "mean", "max", "min", "prod", "std", "var",
+                      "dot")
+_REDUCTION_FUNCS = _REDUCTION_METHODS + (
+    "einsum", "tensordot", "segment_sum", "vdot", "inner", "matmul",
+    "average", "nansum", "nanmean", "amax", "amin")
+_BUCKET_PAD_HELPERS = ("row_bucket", "peak_bucket", "pixel_bucket",
+                       "pow2ish", "batch_bucket_down")
+
+
+def _bucket_pad_source(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        _call_name(node) in _BUCKET_PAD_HELPERS
+
+
+def _masked_helper_clears(call: ast.Call) -> bool:
+    """A masked-helper call consuming the padded block TOGETHER with its
+    real-element count launders the taint: batch_metrics/batch_moments*
+    with an n_real keyword, or the masked Pallas kernel's positional
+    (images, n_real) form."""
+    callee = _call_name(call)
+    if callee not in _MASKED_HELPERS:
+        return False
+    if any(kw.arg == "n_real" for kw in call.keywords):
+        return True
+    return callee == "batch_moments_pallas_masked" and len(call.args) >= 2
+
+
+_MR_FIXTURE_FAIL = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax.numpy as jnp\n"
+        "from ..analysis.numerics import numerics_surface\n"
+        "NUMERICS = numerics_surface(__name__, {\n"
+        "    'score': 'contract=bit_exact; test=tests/test_x.py::test_s; "
+        "padded=images',\n"
+        "})\n"
+        "def score(images, n_real):\n"
+        "    mean = images.mean(axis=-1)\n"
+        "    return mean\n"
+    ),
+}
+_MR_FIXTURE_PASS = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "import jax.numpy as jnp\n"
+        "from ..analysis.numerics import numerics_surface\n"
+        "from ..ops.metrics_jax import batch_metrics\n"
+        "NUMERICS = numerics_surface(__name__, {\n"
+        "    'score': 'contract=bit_exact; test=tests/test_x.py::test_s; "
+        "padded=images',\n"
+        "})\n"
+        "def score(images, theor, nv, n_real):\n"
+        "    out = batch_metrics(images, theor, nv, 8, 8, n_real=n_real)\n"
+        "    # smlint: masked-ok[zero pads are never positive; the count "
+        "is exact]\n"
+        "    npos = jnp.sum(images > 0, axis=-1)\n"
+        "    return out, npos\n"
+    ),
+}
+
+
+@rule("masked-reduction", severity="error",
+      doc="In NUMERICS-declaring modules, reductions (sum/mean/max/dot/"
+          "einsum/segment_sum/...) over values tainted by lattice "
+          "padding — parameters the site's NUMERICS entry declares "
+          "`padded=`, or locals derived from ops/buckets padding helpers "
+          "(row_bucket/peak_bucket/pow2ish/...) — must flow through the "
+          "n_real masked helpers (batch_metrics(n_real=), the "
+          "batch_moments family) or carry a `# smlint: masked-ok[reason]` "
+          "annotation arguing pad-invariance.  Taint is structural: a "
+          "masked-helper call's RESULT is clean; everything else "
+          "propagates.",
+      fixture_fail=_MR_FIXTURE_FAIL, fixture_pass=_MR_FIXTURE_PASS)
+def masked_reduction(project: Project):
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue
+        decl, _ = _numerics_decl(mod)
+        if not decl:
+            continue
+        padded: dict[str, set[str]] = {}
+        for site, (policy, _ln) in decl.items():
+            try:
+                parsed = numerics_mod.parse_policy(policy)
+            except ValueError:
+                continue              # ulp-contract owns grammar findings
+            if "padded" in parsed:
+                padded[site] = {p.strip()
+                                for p in parsed["padded"].split(",")}
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            taint = TaintTracker(source=_bucket_pad_source,
+                                 call_clears=_masked_helper_clears,
+                                 structural=True)
+            params = {a.arg for a in (fn.args.posonlyargs + fn.args.args +
+                                      fn.args.kwonlyargs)}
+            taint.names |= padded.get(fn.name, set()) & params
+            for node in taint.walk(mod, fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = _call_name(node)
+                chain = _attr_chain(node.func)
+                root = chain.split(".")[0]
+                what = None
+                if callee in _REDUCTION_FUNCS and (
+                        root in ("jnp", "np", "numpy", "lax") or
+                        chain.startswith("jax.")):
+                    # function form: jnp.sum(x) / np.mean(x) / lax....
+                    parts = list(node.args) + \
+                        [kw.value for kw in node.keywords]
+                    if any(taint.expr_tainted_rec(p) for p in parts):
+                        what = f"{chain}()"
+                elif isinstance(node.func, ast.Attribute) and \
+                        callee in _REDUCTION_METHODS:
+                    # method form: x.sum() / x.mean() on a tainted receiver
+                    if taint.expr_tainted_rec(node.func.value):
+                        what = f".{callee}()"
+                if what is None:
+                    continue
+                reason = mod.annotation_reason("masked", node.lineno)
+                if reason:
+                    continue
+                if reason == "":
+                    yield _finding(
+                        mod, node,
+                        f"masked-ok annotation for {what} has an empty "
+                        f"reason — the pad-invariance argument is the "
+                        f"point")
+                else:
+                    yield _finding(
+                        mod, node,
+                        f"{what} reduces over a lattice-padded axis "
+                        f"without the n_real masked helpers "
+                        f"(batch_metrics(n_real=)/batch_moments) — pad "
+                        f"slots silently join the reduction; route "
+                        f"through a masked helper or annotate "
+                        f"`# smlint: masked-ok[why pad-invariant]`")
+
+
+# ========================================================== 13. ulp-contract
+_UC_FIXTURE_FAIL = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "from ..analysis.surface import compile_surface\n"
+        "from ..analysis.numerics import numerics_surface\n"
+        "COMPILE_SURFACE = compile_surface(__name__, {\n"
+        "    'score': 'statics=none; buckets=single shape',\n"
+        "    'other': 'statics=none; buckets=single shape',\n"
+        "})\n"
+        "NUMERICS = numerics_surface(__name__, {\n"
+        "    'score': 'contract=ulp(4); test=tests/test_x.py::test_gone',\n"
+        "    'ghost': 'contract=bit_exact; test=tests/test_x.py::test_a',\n"
+        "})\n"
+        "def score(x):\n"
+        "    return x\n"
+        "def other(x):\n"
+        "    return x\n"
+    ),
+    "aux": {"tests/test_x.py": "def test_a():\n    pass\n"},
+}
+_UC_FIXTURE_PASS = {
+    "sm_distributed_tpu/ops/x_jax.py": (
+        "from ..analysis.surface import compile_surface\n"
+        "from ..analysis.numerics import numerics_surface\n"
+        "COMPILE_SURFACE = compile_surface(__name__, {\n"
+        "    'score': 'statics=none; buckets=single shape',\n"
+        "})\n"
+        "NUMERICS = numerics_surface(__name__, {\n"
+        "    'score': 'contract=bit_exact; test=tests/test_x.py::test_a',\n"
+        "})\n"
+        "def score(x):\n"
+        "    return x\n"
+    ),
+    "aux": {"tests/test_x.py": "def test_a():\n    assert True\n"},
+}
+
+
+@rule("ulp-contract", severity="error",
+      doc="Every COMPILE_SURFACE site must declare a numerics contract in "
+          "the module's NUMERICS = numerics_surface(__name__, {...}) "
+          "registry — `contract=bit_exact|ulp(N); test=<file>.py::<name>` "
+          "— and every contract must be cross-referenced by a committed "
+          "test that asserts it (the file must exist and define the "
+          "test).  Dead NUMERICS entries (naming neither a surface site "
+          "nor a function in the module), grammar violations, and "
+          "padded= parameters that don't exist on the named function are "
+          "findings too.",
+      fixture_fail=_UC_FIXTURE_FAIL, fixture_pass=_UC_FIXTURE_PASS)
+def ulp_contract(project: Project):
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue
+        surface, surface_line = _surface_decl(mod)
+        decl, decl_line = _numerics_decl(mod)
+        if decl is None:
+            if surface is not None:
+                yield Finding(
+                    "", "", mod.path, surface_line or 1,
+                    f"module declares a COMPILE_SURFACE ({len(surface or {})} "
+                    f"site(s)) but no NUMERICS = numerics_surface(__name__, "
+                    f"{{...}}) registry — every compiled site needs a "
+                    f"declared numerics contract (bit_exact or ulp(N)) "
+                    f"before precision work can touch it",
+                    anchor="NUMERICS")
+            continue
+        fns: dict[str, ast.AST] = {
+            n.name: n for n in ast.walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        # surface sites must carry contracts
+        for site in sorted(surface or {}):
+            if site not in decl:
+                yield Finding(
+                    "", "", mod.path, (surface or {})[site][1],
+                    f"COMPILE_SURFACE site {site!r} has no NUMERICS "
+                    f"contract — declare contract=bit_exact or ulp(N) "
+                    f"with its proving test",
+                    anchor=f"NUMERICS.{site}")
+        for site, (policy, lineno) in sorted(decl.items()):
+            try:
+                parsed = numerics_mod.parse_policy(policy)
+            except ValueError as exc:
+                yield Finding(
+                    "", "", mod.path, lineno,
+                    f"NUMERICS entry {site!r}: {exc}",
+                    anchor=f"NUMERICS.{site}")
+                continue
+            if site not in (surface or {}) and site not in fns:
+                yield Finding(
+                    "", "", mod.path, lineno,
+                    f"NUMERICS entry {site!r} names neither a "
+                    f"COMPILE_SURFACE site nor a function in this module "
+                    f"(dead entry — remove it or fix the site name)",
+                    anchor=f"NUMERICS.{site}")
+                continue
+            test_path, _, test_name = parsed["test"].partition("::")
+            src = project.read(test_path)
+            if src is None:
+                tmod = project.module(test_path)
+                src = tmod.source if tmod is not None else None
+            if src is None:
+                yield Finding(
+                    "", "", mod.path, lineno,
+                    f"NUMERICS entry {site!r}: contract test file "
+                    f"{test_path!r} does not exist — a contract without "
+                    f"its proving test is an unbacked promise",
+                    anchor=f"NUMERICS.{site}.test")
+            elif f"def {test_name}(" not in src:
+                yield Finding(
+                    "", "", mod.path, lineno,
+                    f"NUMERICS entry {site!r}: {test_path!r} does not "
+                    f"define {test_name!r} — the contract's "
+                    f"cross-referenced test is gone",
+                    anchor=f"NUMERICS.{site}.test")
+            if "padded" in parsed:
+                fn = fns.get(site)
+                if fn is None:
+                    yield Finding(
+                        "", "", mod.path, lineno,
+                        f"NUMERICS entry {site!r} declares padded= but "
+                        f"names no function in this module the parameters "
+                        f"could belong to",
+                        anchor=f"NUMERICS.{site}.padded")
+                else:
+                    params = {a.arg for a in (
+                        fn.args.posonlyargs + fn.args.args +
+                        fn.args.kwonlyargs)}
+                    for p in parsed["padded"].split(","):
+                        if p.strip() not in params:
+                            yield Finding(
+                                "", "", mod.path, lineno,
+                                f"NUMERICS entry {site!r}: padded "
+                                f"parameter {p.strip()!r} is not a "
+                                f"parameter of {site}()",
+                                anchor=f"NUMERICS.{site}.padded")
+
+
+def numerics_census(project: Project) -> dict[str, int]:
+    """Static totals for the analysis drift sentinel: declared numerics
+    contracts and the modules carrying a registry (scripts/smlint.py
+    emits them as sm_numerics_* fields; rising counts diff across the
+    ANALYSIS_r*.json history like any other surface growth)."""
+    contracts = modules = 0
+    for mod in project.modules:
+        if not mod.path.startswith("sm_distributed_tpu/"):
+            continue
+        decl, _ = _numerics_decl(mod)
+        if decl:
+            modules += 1
+            contracts += len(decl)
+    return {"contracts": contracts, "modules": modules}

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.numerics import numerics_surface
 from ..analysis.surface import compile_surface
 from ..io.dataset import SpectralDataset
 from ..ops import buckets as shape_buckets
@@ -96,6 +97,50 @@ COMPILE_SURFACE = compile_surface(__name__, {
         "statics=none; buckets=probe-only — padded (b, k) metric epilogue",
     "isotope_pattern_match_batch":
         "statics=none; buckets=probe-only — padded (b, k) metric epilogue",
+})
+
+# Declared numerics contracts (ISSUE 15, analysis/numerics.py): one per
+# COMPILE_SURFACE site — the drift bound vs the site's reference (numpy
+# oracle or sibling variant), the committed test that proves it, and the
+# lattice-padded operands the masked-reduction rule tracks.  These are
+# the gate for ROADMAP item 3: bf16/int8 compaction may not land unless
+# every contract still holds (scripts/ulp_sentinel.py is the runtime
+# check on the spheroid fixture).
+NUMERICS = numerics_surface(__name__, {
+    "fused_score_fn_chunked":
+        "contract=ulp(8); test=tests/test_mz_chunking.py::"
+        "test_chunked_scores_match",
+    "fused_score_fn_flat_banded":
+        "contract=ulp(16); test=tests/test_buckets.py::"
+        "test_bucketed_scoring_bit_identical_fdr; "
+        "padded=pixel_sorted,int_sorted",
+    "fused_score_fn_flat_banded_compact":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_peak_compaction_bit_exact; padded=pixel_sorted,int_sorted",
+    "fused_score_fn_flat_banded_sliced":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_band_slice_bit_exact; padded=pixel_sorted,int_sorted",
+    "extract_images":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_extraction_parity",
+    "extract_images_flat":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_extraction_flat_bit_identical_to_cube",
+    "ext_base":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_extraction_flat_bit_identical_to_cube",
+    "batch_moments":
+        "contract=ulp(16); test=tests/test_moments.py::"
+        "test_moments_jnp_fallback_matches_f64",
+    "measure_of_chaos_batch":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_chaos_batch_matches_numpy",
+    "correlation_from_moments":
+        "contract=ulp(16); test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks",
+    "isotope_pattern_match_batch":
+        "contract=ulp(16); test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks",
 })
 
 

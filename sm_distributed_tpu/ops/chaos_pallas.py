@@ -42,7 +42,22 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis.numerics import numerics_surface
 from ..analysis.surface import compile_surface
+
+# Declared numerics contracts (ISSUE 15): all chaos routes are EXACT —
+# integer component counts off exact thresholds — so the dispatch can
+# never change results; pad pixels are below every positive threshold
+# and join no component, so the kernels are pad-invariant without
+# masking (the batch_metrics docstring carries the argument).
+NUMERICS = numerics_surface(__name__, {
+    "chaos_count_sums":
+        "contract=bit_exact; test=tests/test_chaos_pallas.py::"
+        "test_matches_full_chaos_oracle",
+    "chaos_count_sums_strips":
+        "contract=bit_exact; test=tests/test_chaos_pallas.py::"
+        "test_strip_kernel_matches_scipy",
+})
 
 # Declared compile surface (ISSUE 12, analysis/surface.py): both kernels'
 # statics are per-dataset image geometry plus fixed tuning constants, so
@@ -386,7 +401,7 @@ def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
                 return lab, moved
 
             lab_fin, _ = lax.while_loop(lambda st: st[1], body,
-                                        (lab_in, jnp.array(True)))
+                                        (lab_in, jnp.array(True, dtype=jnp.bool_)))
             changed = jnp.any((lab_fin != lab_in) & core)
 
             @pl.when(changed)
@@ -411,14 +426,14 @@ def _chaos_strip_kernel(smax_ref, img_ref, out_ref, lab_hbm, img_vmem,
                 # direction cascade across all boundaries within one pass
                 s = jnp.where(p % 2 == 0, i, n_strips - 1 - i)
                 nonempty = smax_ref[pid, s] > thr
-                ch = lax.cond(nonempty, visit, lambda _s: jnp.array(False), s)
+                ch = lax.cond(nonempty, visit, lambda _s: jnp.array(False, dtype=jnp.bool_), s)
                 return jnp.logical_or(any_changed, ch)
 
-            changed = lax.fori_loop(0, n_strips, strip_body, jnp.array(False))
+            changed = lax.fori_loop(0, n_strips, strip_body, jnp.array(False, dtype=jnp.bool_))
             return p + 1, changed
 
         lax.while_loop(lambda st: st[1], pass_body,
-                       (jnp.int32(0), jnp.array(True)))
+                       (jnp.int32(0), jnp.array(True, dtype=jnp.bool_)))
 
         # ---- count roots: label == own iota (transform re-applied on load
         # because converged strips skip write-back) ----

@@ -46,8 +46,21 @@ import functools
 
 import numpy as np
 
+from ..analysis.numerics import numerics_surface
 from ..analysis.surface import compile_surface
 from .isocalc import SEGMENT_GRID_CAP
+
+# Declared numerics contract (ISSUE 15): the dense blur->centroid kernel
+# is a different ALGORITHM than the oracle's scatter-add (module doc:
+# ~3e-7 Da m/z, ~1e-5 relative intensity over 1,800 real ions), so the
+# declared budget is ulp(128) — ~1e-5 relative in f32 — with the
+# measured-parity test as its proof.  Device-mode caches key separately
+# for exactly this reason.
+NUMERICS = numerics_surface(__name__, {
+    "run":
+        "contract=ulp(128); test=tests/test_isocalc_parallel.py::"
+        "test_device_blur_centroid_matches_oracle",
+})
 
 # Declared compile surface (ISSUE 12, analysis/surface.py): the blur->
 # centroid kernel closes over its (grid, states, rows, k) shape — one
@@ -136,12 +149,12 @@ def _kernel(lc: int, sc: int, b: int, k: int,
         cand = jnp.where(mids, p[:, 1:-1], -1.0)
         v, li = jax.lax.top_k(cand, k)                         # (B, k)
         li = li + 1
-        rows = jnp.arange(b)[:, None]
+        rows = jnp.arange(b, dtype=jnp.int32)[:, None]
         y0, y1, y2 = p[rows, li - 1], p[rows, li], p[rows, li + 1]
         # fallback support: the profile argmax (oracle: "no local max ->
         # argmax"), with its parabola neighbors
         gm = jnp.clip(jnp.argmax(p, axis=1), 1, lc - 2)
-        r = jnp.arange(b)
+        r = jnp.arange(b, dtype=jnp.int32)
         fb = jnp.stack([p[r, gm], p[r, gm - 1], p[r, gm + 1]], axis=1)
         return v, li, y0, y1, y2, gm, fb
 

@@ -29,6 +29,34 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..analysis.numerics import numerics_surface
+
+# Declared numerics contracts (ISSUE 15, analysis/numerics.py): per-site
+# drift bound vs the numpy oracle, the committed test that proves it, and
+# the parameters that receive lattice-padded blocks (ISSUE 13) — the
+# masked-reduction rule seeds its taint from `padded=`, so a raw
+# reduction over a padded axis that skips the n_real helpers is a lint
+# error here, not a silent metric corruption at scale.
+NUMERICS = numerics_surface(__name__, {
+    "batch_metrics":
+        "contract=ulp(16); test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks; padded=images",
+    "measure_of_chaos_batch":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_chaos_batch_matches_numpy; padded=principal",
+    "hotspot_clip_batch":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_hotspot_clip_batch_matches_numpy; padded=images",
+    "correlation_from_moments":
+        "contract=ulp(16); test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks",
+    "isotope_image_correlation_batch":
+        "contract=ulp(16); test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks; padded=images",
+    "isotope_pattern_match_batch":
+        "contract=ulp(16); test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks",
+})
 
 # numpy scalar, NOT jnp: a module-level jnp value would initialize the XLA
 # backend at import time, which forbids jax.distributed.initialize later
@@ -117,8 +145,10 @@ def measure_of_chaos_batch(
         route = "scan"
     principal = jnp.maximum(principal, 0.0)
     if vmax is None:
+        # smlint: masked-ok[lattice pad pixels are exact zeros, below every positive max — vmax is the real-pixel maximum]
         vmax = principal.max(axis=1)                   # (N,)
     if n_notnull is None:
+        # smlint: masked-ok[zero pads are never > 0; the positive count is pad-invariant]
         n_notnull = jnp.sum(principal > 0, axis=1)     # (N,)
 
     if route == "packed":
@@ -165,11 +195,13 @@ def correlation_from_moments(
     moments (ops/moments_pallas.py) — the two must stay in lockstep."""
     norm = jnp.sqrt(normsq)
     denom = norm[:, 0:1] * norm
-    corr = jnp.where(denom > 0, dots / jnp.maximum(denom, 1e-30), 0.0)
+    corr = jnp.where(denom > 0,
+                     dots / jnp.maximum(denom, np.float32(1e-30)), 0.0)
     w = jnp.where(valid, weights, 0.0).at[:, 0].set(0.0)
     wsum = w.sum(axis=1)
     out = jnp.where(
-        wsum > 0, (corr * w).sum(axis=1) / jnp.maximum(wsum, 1e-30), 0.0)
+        wsum > 0,
+        (corr * w).sum(axis=1) / jnp.maximum(wsum, np.float32(1e-30)), 0.0)
     return jnp.clip(out, 0.0, 1.0)
 
 
@@ -186,10 +218,13 @@ def isotope_image_correlation_batch(
     base = cent[:, 0, :]                                    # (N, P)
     dots = jnp.einsum("np,nkp->nk", base, cent)             # (N, K)
     denom = norm[:, 0:1] * norm                             # (N, K)
-    corr = jnp.where(denom > 0, dots / jnp.maximum(denom, 1e-30), 0.0)
+    corr = jnp.where(denom > 0,
+                     dots / jnp.maximum(denom, np.float32(1e-30)), 0.0)
     w = jnp.where(valid, weights, 0.0).at[:, 0].set(0.0)    # exclude principal
     wsum = w.sum(axis=1)
-    out = jnp.where(wsum > 0, (corr * w).sum(axis=1) / jnp.maximum(wsum, 1e-30), 0.0)
+    out = jnp.where(
+        wsum > 0,
+        (corr * w).sum(axis=1) / jnp.maximum(wsum, np.float32(1e-30)), 0.0)
     return jnp.clip(out, 0.0, 1.0)
 
 
@@ -204,7 +239,8 @@ def isotope_pattern_match_batch(
     on = jnp.sqrt(jnp.sum(obs * obs, axis=1))
     tn = jnp.sqrt(jnp.sum(th * th, axis=1))
     dot = jnp.sum(obs * th, axis=1)
-    out = jnp.where((on > 0) & (tn > 0), dot / jnp.maximum(on * tn, 1e-30), 0.0)
+    out = jnp.where((on > 0) & (tn > 0),
+                    dot / jnp.maximum(on * tn, np.float32(1e-30)), 0.0)
     return jnp.clip(out, 0.0, 1.0)
 
 
@@ -224,6 +260,7 @@ def hotspot_clip_batch(images: jnp.ndarray, q: float) -> jnp.ndarray:
     an FMA, whose different rounding would flip clipped-pixel bits."""
     p = images.shape[-1]
     srt = jnp.sort(images, axis=-1)
+    # smlint: masked-ok[zero pads are never > 0 and sort to the low slots; m and the index arithmetic are pad-count invariant by construction]
     m = jnp.sum(images > 0, axis=-1).astype(jnp.int32)     # (...,)
     t = np.float32(q) / np.float32(100.0)                  # host f32 constant
     pos = t * jnp.maximum(m - 1, 0).astype(jnp.float32)    # one rounded mul
@@ -266,7 +303,7 @@ def batch_metrics(
     integers either way.  Result: metrics are bit-identical to unpadded
     scoring while every dataset size in a bucket shares ONE executable."""
     k = images.shape[1]
-    valid = jnp.arange(k)[None, :] < n_valid[:, None]
+    valid = jnp.arange(k, dtype=jnp.int32)[None, :] < n_valid[:, None]
     images = jnp.where(valid[:, :, None], images, 0.0)
     if do_preprocessing:
         images = hotspot_clip_batch(images, q)

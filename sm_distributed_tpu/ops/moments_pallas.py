@@ -32,7 +32,29 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+from ..analysis.numerics import numerics_surface
 from ..analysis.surface import compile_surface
+
+# Declared numerics contracts (ISSUE 15, analysis/numerics.py): the
+# Pallas kernels reduce in a different order than XLA's tree (ulp-grade
+# drift, the documented cross-backend contract); the masked jnp fallback
+# is bit-exact vs unpadded by construction.  `padded=images` seeds the
+# masked-reduction rule's taint — every raw reduction below carries its
+# own pad-invariance argument as a masked-ok annotation.
+NUMERICS = numerics_surface(__name__, {
+    "batch_moments_pallas":
+        "contract=ulp(16); test=tests/test_moments.py::"
+        "test_moments_interpret_matches_f64",
+    "batch_moments_pallas_masked":
+        "contract=ulp(16); test=tests/test_buckets.py::"
+        "test_masked_moments_match_unpadded; padded=images",
+    "batch_moments_jnp":
+        "contract=bit_exact; test=tests/test_buckets.py::"
+        "test_masked_moments_match_unpadded; padded=images",
+    "batch_moments":
+        "contract=ulp(16); test=tests/test_moments.py::"
+        "test_moments_jnp_fallback_matches_f64; padded=images",
+})
 
 # Declared compile surface (ISSUE 12, analysis/surface.py).
 COMPILE_SURFACE = compile_surface(__name__, {
@@ -205,6 +227,7 @@ def batch_moments_jnp(images: jnp.ndarray, n_real=None):
     ``n_real == P`` (or None) the arithmetic is the unpadded sequence
     bit-for-bit: the mask keeps every value and the division sees the
     same operands."""
+    # smlint: masked-ok[pad pixels are exact zeros and add exactly 0 to every f32 sum; only the MEAN divides by a count, and it takes n_real below]
     sums = images.sum(axis=-1)
     if n_real is None:
         mean = sums[..., None] / np.float32(images.shape[-1])
@@ -214,10 +237,14 @@ def batch_moments_jnp(images: jnp.ndarray, n_real=None):
         real = (jnp.arange(images.shape[-1], dtype=jnp.int32)
                 < n_real)[None, None, :]
         cent = jnp.where(real, images - mean, 0.0)
+    # smlint: masked-ok[cent is masked back to exact zero past n_real, so pad slots contribute 0.0 to the squared norm]
     normsq = jnp.sum(cent * cent, axis=-1)
+    # smlint: masked-ok[both einsum operands are zero-masked past n_real; pad products are exact zeros]
     dots = jnp.einsum("np,nkp->nk", cent[:, 0, :], cent)
     principal = images[:, 0, :]
+    # smlint: masked-ok[zero pads never exceed a positive maximum; empty rows yield 0 either way]
     vmax = principal.max(axis=1)
+    # smlint: masked-ok[zero pads are never > 0; the positive count is pad-invariant]
     nn = jnp.sum((principal > 0).astype(jnp.float32), axis=1)
     return sums, normsq, dots, vmax, nn
 

@@ -17,6 +17,27 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..analysis.numerics import numerics_surface
+
+# Declared numerics contracts (ISSUE 15): the quantization grid IS the
+# cross-backend bit-exactness mechanism — host f64 in, shared int32/f32
+# grids out, identical for numpy_ref and jax_tpu by construction.  The
+# extraction/metric parity tests are the committed proof.
+NUMERICS = numerics_surface(__name__, {
+    "quantize_mz":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_extraction_parity",
+    "quantize_window":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_extraction_parity",
+    "quantize_intensities":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks",
+    "intensity_scale":
+        "contract=bit_exact; test=tests/test_jax_backend.py::"
+        "test_backend_parity_metrics_and_ranks",
+})
+
 MZ_SCALE = 1e5  # quantization steps per Da
 MZ_MAX = (2**31 - 2) / MZ_SCALE
 # padding sentinel for m/z cubes: larger than any real quantized m/z

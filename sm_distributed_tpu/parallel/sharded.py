@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..analysis.numerics import numerics_surface
 from ..analysis.surface import compile_surface
 from ..io.dataset import SpectralDataset
 from ..ops import buckets as shape_buckets
@@ -78,6 +79,21 @@ COMPILE_SURFACE = compile_surface(__name__, {
     "sharded":
         "statics=closure(gc_width,n_keep,w_cap); buckets=jit of the "
         "shard_mapped step, cached per triple in ShardedJaxBackend._fns",
+})
+
+# Declared numerics contracts (ISSUE 15): the sharded step slices its
+# all_to_all concat to the SAME row bucket the single-device path uses
+# (ISSUE 13), so sharded scoring is BIT-equal to the single-device fused
+# graph — the strongest cross-variant contract in the tree.  The shard
+# rows ride the lattice, hence `padded=px_s,in_s` for the
+# masked-reduction taint.
+NUMERICS = numerics_surface(__name__, {
+    "step":
+        "contract=bit_exact; test=tests/test_parallel.py::"
+        "test_sharded_matches_single_device; padded=px_s,in_s",
+    "sharded":
+        "contract=bit_exact; test=tests/test_parallel.py::"
+        "test_sharded_peak_compaction_bit_exact",
 })
 
 

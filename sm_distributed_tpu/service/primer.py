@@ -48,6 +48,7 @@ import threading
 import time
 from pathlib import Path
 
+from ..analysis.numerics import numerics_surface
 from ..analysis.surface import compile_surface
 from ..ops import buckets as shape_buckets
 from ..utils.logger import logger
@@ -64,6 +65,17 @@ COMPILE_SURFACE = compile_surface(__name__, {
         "specs the backends recorded (flat AND mesh-shaped sharded, "
         "keyed on lease topology), so its surface is a subset of "
         "models/msm_jax's plus parallel/sharded's",
+})
+
+# Declared numerics contract (ISSUE 15): the primer rebuilds the
+# BYTE-identical program a recorded spec dispatched (same function
+# objects, same partial closure, same statics), so a primed executable
+# is bit-for-bit the one a later real job looks up — priming can never
+# change results.
+NUMERICS = numerics_surface(__name__, {
+    "prime_spec":
+        "contract=bit_exact; test=tests/test_buckets.py::"
+        "test_primer_idempotent_and_resumable",
 })
 
 

@@ -1,0 +1,235 @@
+"""Shared forward-dataflow / taint engine tests (ISSUE 15).
+
+The engine (``analysis/dataflow.py``) replaces the ad-hoc taint walks
+that grew inside ``fence-gate`` and ``retrace-hazard``; the contract is
+(1) the primitives behave — def-use chains, taint through single-target
+locals and dict-call sinks, sanitizer laundering, structural clearing
+calls, single-level call summaries, the guarded summary cache — and
+(2) the refactored rules produce FINDING-FOR-FINDING parity with the
+pre-refactor walks on the current tree (the committed snapshot below).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from sm_distributed_tpu.analysis import dataflow
+from sm_distributed_tpu.analysis import rules as rules_mod  # noqa: F401
+from sm_distributed_tpu.analysis.core import Module, Project, run_lint
+from sm_distributed_tpu.analysis.dataflow import (
+    SummaryCache,
+    TaintTracker,
+    def_use,
+    function_nodes,
+    module_summaries,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def _mod(src: str, path: str = "sm_distributed_tpu/x.py") -> Module:
+    return Module(path, src)
+
+
+def _fn(mod: Module, name: str):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    raise AssertionError(f"no function {name}")
+
+
+# ------------------------------------------------------------ function_nodes
+def test_function_nodes_excludes_nested_defs():
+    mod = _mod(
+        "def outer(x):\n"
+        "    a = x + 1\n"
+        "    def inner(y):\n"
+        "        b = y + 2\n"
+        "        return b\n"
+        "    return inner(a)\n"
+    )
+    names = {n.targets[0].id for n in function_nodes(mod, _fn(mod, "outer"))
+             if isinstance(n, ast.Assign)}
+    assert names == {"a"}              # inner's `b` belongs to inner
+
+
+# ------------------------------------------------------------------- def-use
+def test_def_use_chains():
+    mod = _mod(
+        "def f(x):\n"
+        "    n = x.shape[0]\n"
+        "    m = n + 1\n"
+        "    n = m\n"
+        "    return n\n"
+    )
+    du = def_use(mod, _fn(mod, "f"))
+    defs, uses = du.chain("n")
+    assert len(defs) == 2              # both single-target assignments
+    assert len(uses) == 2              # n + 1, return n
+    assert du.chain("m")[0][0].lineno == 3
+
+
+# --------------------------------------------------------------- flat taint
+def test_taint_through_single_target_locals():
+    mod = _mod(
+        "def f(x):\n"
+        "    n = x.shape[0]\n"
+        "    m = n + 1\n"
+        "    k = unrelated()\n"
+    )
+    taint = TaintTracker(source=rules_mod._is_shape_source)
+    for _ in taint.walk(mod, _fn(mod, "f")):
+        pass
+    assert taint.names == {"n", "m"}
+
+
+def test_sanitizer_clears_whole_expression():
+    mod = _mod(
+        "def f(x):\n"
+        "    n = x.shape[0]\n"
+        "    b = size_bucket(n) + n\n"   # one bucketing call launders all
+    )
+    taint = TaintTracker(source=rules_mod._is_shape_source,
+                         sanitizer=rules_mod._is_bucketing_call)
+    for _ in taint.walk(mod, _fn(mod, "f")):
+        pass
+    assert taint.names == {"n"}
+
+
+def test_dict_call_keyword_sink_taint():
+    """The retrace-hazard dict-sink shape: `statics = dict(b=n)` keeps the
+    keyword visible to sink checks while `statics` itself is tainted."""
+    mod = _mod(
+        "def go(x):\n"
+        "    n = x.shape[0]\n"
+        "    statics = dict(b=n)\n"
+        "    return fn(x, **statics)\n"
+    )
+    taint = TaintTracker(source=rules_mod._is_shape_source)
+    hits = []
+    for node in taint.walk(mod, _fn(mod, "go")):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg == "b" and taint.expr_tainted(kw.value):
+                    hits.append(node.lineno)
+    assert hits == [3] and "statics" in taint.names
+
+
+# --------------------------------------------------------- structural taint
+def test_structural_clearing_call_launders():
+    mod = _mod(
+        "def f(images, n_real):\n"
+        "    out = batch_metrics(images, n_real=n_real)\n"
+        "    raw = other(images)\n"
+    )
+    taint = TaintTracker(
+        call_clears=rules_mod._masked_helper_clears, structural=True)
+    taint.names.add("images")
+    for _ in taint.walk(mod, _fn(mod, "f")):
+        pass
+    assert "out" not in taint.names    # masked helper result is clean
+    assert "raw" in taint.names        # arbitrary calls propagate
+
+
+def test_structural_tuple_unpack_taints_all_targets():
+    mod = _mod(
+        "def f(images):\n"
+        "    a, b = split(images)\n"
+    )
+    taint = TaintTracker(structural=True)
+    taint.names.add("images")
+    for _ in taint.walk(mod, _fn(mod, "f")):
+        pass
+    assert {"a", "b"} <= taint.names
+
+
+# ------------------------------------------------------------- call summaries
+def test_module_summaries_param_flows_through_local():
+    mod = _mod(
+        "def keep(v):\n"
+        "    w = v * 2\n"
+        "    return w\n"
+        "def drop(v):\n"
+        "    return 1\n"
+    )
+    s = module_summaries(mod)
+    assert s["keep"] == (("v",), frozenset({"v"}))
+    assert s["drop"] == (("v",), frozenset())
+
+
+def test_summaries_are_authoritative_in_structural_mode():
+    mod = _mod(
+        "def keep(v):\n"
+        "    return v\n"
+        "def drop(v):\n"
+        "    return 1\n"
+        "def go(x):\n"
+        "    a = keep(x)\n"
+        "    b = drop(x)\n"
+    )
+    taint = TaintTracker(summaries=module_summaries(mod), structural=True)
+    taint.names.add("x")
+    for _ in taint.walk(mod, _fn(mod, "go")):
+        pass
+    assert "a" in taint.names          # flows through keep's param
+    assert "b" not in taint.names      # drop's param never reaches return
+
+
+def test_summary_keyword_argument_flow():
+    mod = _mod(
+        "def helper(u, v=0):\n"
+        "    return v\n"
+        "def go(x):\n"
+        "    a = helper(1, v=x)\n"
+        "    b = helper(x, v=2)\n"
+    )
+    taint = TaintTracker(summaries=module_summaries(mod), structural=True)
+    taint.names.add("x")
+    for _ in taint.walk(mod, _fn(mod, "go")):
+        pass
+    assert "a" in taint.names and "b" not in taint.names
+
+
+def test_summary_cache_hits_and_clear():
+    cache = SummaryCache()
+    mod = _mod("def f(v):\n    return v\n")
+    first = cache.get(mod)
+    assert cache.get(mod) is first     # memoized by (path, source hash)
+    edited = _mod("def f(v):\n    return 1\n")
+    assert cache.get(edited) is not first
+    cache.clear()
+    assert cache.get(mod) is not first
+    assert dataflow.summaries._GUARDED_BY == {"_cache": "_lock"}
+
+
+# ------------------------------------------- refactor parity (the snapshot)
+# The findings the PRE-refactor in-line walks produced on this tree,
+# keyed line-independently as (path, anchor, seam prefix).  The
+# refactored rules must reproduce them finding-for-finding.
+_FENCE_SNAPSHOT = {
+    ("sm_distributed_tpu/engine/daemon.py", "QueueConsumer.process_one",
+     "terminal-spool write (failed)"),
+    ("sm_distributed_tpu/engine/daemon.py", "QueueConsumer.process_one",
+     "spool complete (running/ -> done/)"),
+    ("sm_distributed_tpu/service/scheduler.py", "JobScheduler.cancel",
+     "terminal-spool move"),
+    ("sm_distributed_tpu/service/scheduler.py", "JobScheduler.cancel",
+     "terminal-spool write ((tainted path))"),
+    ("sm_distributed_tpu/service/scheduler.py", "JobScheduler._quarantine",
+     "terminal-spool write (quarantine)"),
+}
+
+
+def test_refactored_rules_match_prerefactor_snapshot():
+    """Finding-for-finding parity on the current tree: the dataflow-engine
+    rewrites of fence-gate and retrace-hazard report exactly the findings
+    the ad-hoc walks did (fence-gate's five baselined seams, zero retrace
+    hazards)."""
+    proj = Project.load(REPO_ROOT, ["sm_distributed_tpu", "scripts",
+                                    "bench.py"])
+    res = run_lint(proj, only={"fence-gate", "retrace-hazard"})
+    fence = {(f.path, f.anchor, f.message.split(" is not dominated")[0])
+             for f in res.new if f.rule == "fence-gate"}
+    assert fence == _FENCE_SNAPSHOT
+    assert [f for f in res.new if f.rule == "retrace-hazard"] == []
