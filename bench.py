@@ -473,6 +473,66 @@ def measure_multichip(cfg: BenchConfig, prep: dict, cache_dir: Path,
     }
 
 
+def measure_read(n_rows: int = 2000, n_reads: int = 200) -> dict:
+    """Read-plane pins (ISSUE 16): a synthetic ``n_rows`` columnar segment
+    queried ``n_reads`` times through the real ReadPath handlers with a
+    mixed cold/warm key population (20 distinct filter/sort/page shapes,
+    cycled — the first pass is cold segment scans, the rest are LRU hits,
+    roughly the production hit ratio the cache is sized for).  Pins
+    ``reads_per_s`` and ``read_p50_ms``; perf_sentinel bands both."""
+    import shutil
+    import tempfile
+
+    import pandas as pd
+
+    from sm_distributed_tpu.engine.index import publish_segment
+    from sm_distributed_tpu.service.readpath import ReadPath
+    from sm_distributed_tpu.utils.config import ReadPathConfig
+
+    root = Path(tempfile.mkdtemp(prefix="sm_bench_read_"))
+    try:
+        rng = np.random.default_rng(16)
+        df = pd.DataFrame({
+            "sf": [f"C{i % 40 + 1}H{i % 30 + 2}O{i % 7}N{i % 3}"
+                   for i in range(n_rows)],
+            "adduct": [("+H", "+Na", "+K")[i % 3] for i in range(n_rows)],
+            "msm": rng.uniform(0, 1, n_rows),
+            "fdr": rng.uniform(0, 0.5, n_rows),
+            "fdr_level": rng.choice([0.05, 0.1, 0.2, 0.5], n_rows),
+            "chaos": rng.uniform(0, 1, n_rows),
+            "spatial": rng.uniform(0, 1, n_rows),
+            "spectral": rng.uniform(0, 1, n_rows)})
+        mzs = {(r.sf, r.adduct): 100.0 + i % 900
+               for i, r in enumerate(df.itertuples())}
+        d = root / "bench_ds"
+        d.mkdir()
+        publish_segment(d, "bench_ds", 1, df, mzs)
+        rp = ReadPath(root, ReadPathConfig())
+        shapes = [
+            {"order": [o], "dir": [dn], "limit": [str(lim)], **flt}
+            for o in ("msm", "mz") for dn in ("desc", "asc")
+            for lim, flt in (
+                ("100", {}), ("25", {"adduct": ["+H"]}),
+                ("50", {"fdr": ["0.2"]}),
+                ("100", {"min_msm": ["0.5"]}),
+                ("10", {"mz_min": ["200"], "mz_max": ["600"]}))]
+        lats = []
+        t0 = time.perf_counter()
+        for i in range(n_reads):
+            t1 = time.perf_counter()
+            status, _body, _hd = rp.handle_annotations(
+                "bench_ds", shapes[i % len(shapes)])
+            lats.append(time.perf_counter() - t1)
+            assert status == 200, f"bench read returned {status}"
+        total = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    lats.sort()
+    return {"reads_per_s": round(n_reads / total, 2),
+            "read_p50_ms": round(lats[len(lats) // 2] * 1000.0, 3),
+            "read_rows": n_rows, "read_n": n_reads}
+
+
 def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
            cfg: BenchConfig | None = None, cold: dict | None = None) -> dict:
     iso = iso or {}
@@ -678,6 +738,7 @@ def main() -> None:
         out["multichip"] = measure_multichip(
             configs[-1], preps[-1], cache_dir, args.devices,
             args.mesh_formulas)
+    out.update(measure_read())          # ISSUE 16 read-plane pins
     compile_snap = retrace.snapshot()
     out["compile_events"] = compile_snap["events_total"]
     out["compile_signatures"] = compile_snap["signatures_total"]

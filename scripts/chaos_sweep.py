@@ -44,9 +44,13 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
 # import every module hosting an injection seam so the registry is complete
+# (engine.index is imported lazily by storage.store, readpath only by the
+# server wiring — without these the read-plane failpoints would be invisible)
+import sm_distributed_tpu.engine.index  # noqa: F401,E402
 import sm_distributed_tpu.io.imzml  # noqa: F401,E402
 import sm_distributed_tpu.models.msm_basic  # noqa: F401,E402
 import sm_distributed_tpu.service.fleet  # noqa: F401,E402
+import sm_distributed_tpu.service.readpath  # noqa: F401,E402
 import sm_distributed_tpu.service.scheduler  # noqa: F401,E402
 from sm_distributed_tpu.engine.daemon import (  # noqa: E402
     QUEUE_ANNOTATE,
@@ -112,6 +116,10 @@ class Scenario:
     # True = converge to a fault-free golden run under THIS scenario's sm
     # overrides (see GoldenCache); False = the base (numpy) golden
     golden_sm: bool = False
+    # substring that must appear in the combined run output (beyond the
+    # FAILPOINT-FIRED line): scenarios whose proof is an in-process check
+    # (e.g. the read-path probe) print a marker the driver asserts on
+    expect: str = ""
 
     @property
     def key(self) -> str:
@@ -269,6 +277,19 @@ SCENARIOS: list[Scenario] = [
              "fleet controller killed mid-spawn (no replica launched); the "
              "restarted controller repairs the fleet and the job completes "
              "exactly once"),
+    # --- result read-plane seams (ISSUE 16) ----------------------------
+    Scenario("index.segment_commit", "consume",
+             "index.segment_commit=crash@1",
+             "crash between the read-segment tmp write and its atomic "
+             "swap: readers keep the previous complete segment (never a "
+             "torn one), the rerun republishes and sweeps the tmp"),
+    # SM_CHAOS_READ=1 makes the consume subprocess drive the governed read
+    # path over the freshly published segment IN the faulted process: the
+    # cache-fill fault must degrade to a source read, never a failed GET
+    Scenario("read.cache_fill", "consume", "read.cache_fill=raise:OSError@1",
+             "cache-fill fault on the first read: the read still answers "
+             "from the source segment and the retry warms the cache",
+             env={"SM_CHAOS_READ": "1"}, expect="CHAOS-READ-OK"),
 ]
 
 SMOKE = ("ckpt.shard_write", "spool.complete", "storage.results_rename")
@@ -306,6 +327,23 @@ def cmd_consume_one(queue_dir: str, sm_config_path: str) -> int:
             time.sleep(0.02)
         sched.registry.request_drain(sched.replica_id, by="chaos")
     ok = sched.wait_for_terminal(1, timeout_s=60.0)
+    if ok and os.environ.get("SM_CHAOS_READ") == "1":
+        # read-plane chaos (ISSUE 16): query the just-published segment
+        # twice through a real ReadPath while the cache-fill seam is
+        # faulted — both reads MUST answer (the fill failure only costs
+        # cache warmth); the driver asserts on the CHAOS-READ-OK marker
+        from sm_distributed_tpu.service.readpath import ReadPath
+
+        rp = ReadPath(sm.storage.results_dir, sm.service.read)
+        body = None
+        for _ in range(2):
+            status, body, _hdrs = rp.handle_annotations(DS_ID, {})
+            if status != 200:
+                print(f"CHAOS-READ-FAIL status={status} body={body}",
+                      flush=True)
+                sched.shutdown()
+                return 4
+        print(f"CHAOS-READ-OK rows={body['total']}", flush=True)
     if drain_mode:
         # hold the process open through the ack so the fleet.retire_ack
         # seam executes before shutdown tears the replica loop down
@@ -507,6 +545,24 @@ def check_invariants(ctx: Context, golden) -> list[str]:
             errs.append(f"index has {idx_rows} rows, golden {len(golden[0])}")
     finally:
         ledger.close()
+    # read-segment invariant (ISSUE 16): after convergence the dataset's
+    # columnar read segment must exist, load cleanly (readers can never
+    # see a torn file under the atomic-swap protocol), and carry exactly
+    # the golden row count
+    from sm_distributed_tpu.engine.index import (SEGMENT_NAME, SegmentError,
+                                                 _load_file)
+
+    seg_path = ctx.results / DS_ID / SEGMENT_NAME
+    if not seg_path.exists():
+        errs.append("no published read segment")
+    else:
+        try:
+            seg = _load_file(seg_path)
+            if seg.n_rows != len(golden[0]):
+                errs.append(f"read segment has {seg.n_rows} rows, "
+                            f"golden {len(golden[0])}")
+        except SegmentError as exc:
+            errs.append(f"torn/unreadable read segment: {exc}")
     got = _read_report(ctx.results)
     _assert_frames_equal(got[0], golden[0], "annotations", errs)
     _assert_frames_equal(got[1], golden[1], "all_metrics", errs)
@@ -561,6 +617,10 @@ def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
     blob = "".join(outputs)
     if f"FAILPOINT-FIRED name={sc.primary}" not in blob:
         result["error"] = f"failpoint {sc.primary} never fired"
+        return result
+    if sc.expect and sc.expect not in blob:
+        result["error"] = f"expected marker {sc.expect!r} never appeared"
+        result["output_tail"] = outputs[-1][-2000:]
         return result
     # one final operator pass so crash-specific ledger rows are reconciled
     ctx.recover()

@@ -167,6 +167,16 @@ if ! env JAX_PLATFORMS=cpu python scripts/resource_smoke.py; then
     exit 1
 fi
 
+# read-path smoke gate (ISSUE 16): the spheroid fixture annotated through
+# the real service, then read back over HTTP — cold query answers from the
+# columnar segment, the warm repeat is a cache hit with p50 < 50 ms, the
+# result matches a brute-force parquet scan, tile bytes are bit-identical
+# to a direct engine/png.py render, and /slo carries the read SLI
+if ! env JAX_PLATFORMS=cpu python scripts/read_smoke.py; then
+    echo "check_tier1: FAIL — read-path smoke gate failed" >&2
+    exit 1
+fi
+
 # replica failover smoke gate (ISSUE 8): 3 real scheduler replica
 # processes over one partitioned spool; killing one mid-score (and pausing
 # one into a fence race) must converge every job exactly-once to the

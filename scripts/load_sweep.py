@@ -63,6 +63,7 @@ import sys
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from pathlib import Path
 
@@ -510,9 +511,12 @@ def mix_disk(base: Path, fx: dict) -> None:
         _check('sm_disk_degraded_writes_total{kind="trace"}' in text,
                "disk: trace-drop counter missing from /metrics")
         # deepen to the submit floor: structured 507 shed
+        from sm_distributed_tpu.service.resources import LEVEL_SHED_SUBMITS
+
         filler.write_bytes(b"\0" * (60 * mb))
         deadline = time.time() + 10.0
-        while governor.level() < 3 and time.time() < deadline:
+        while governor.level() < LEVEL_SHED_SUBMITS and \
+                time.time() < deadline:
             time.sleep(0.05)
         status, headers, body = h.submit(_msg(fx, "fast", "disk_shed"))
         _check(status == 507 and body.get("reason") == "disk_exhausted",
@@ -916,9 +920,187 @@ def mix_elastic(base: Path, n_jobs: int = 420, p99_bound_s: float = 30.0) -> Non
           f"p99 {p99:.2f}s")
 
 
+def _http_raw(base: str, path: str):
+    """(status, headers, raw bytes) — for read-path GETs (tiles are PNG)."""
+    req = urllib.request.Request(base + path)
+    try:
+        with urllib.request.urlopen(req, timeout=30.0) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def mix_read(base: Path, fx: dict, n_readers: int = 6, reads_each: int = 30,
+             n_writes: int = 4, p99_bound_s: float = 1.0) -> None:
+    """Read-plane mix (ISSUE 16): ~90/10 read/write over TWO in-process
+    replicas sharing one spool + results tree.  Readers storm /datasets,
+    annotation queries, cohorts, and tiles while a writer re-annotates one
+    of the datasets (segment republish under read load); read admission is
+    squeezed (``read.max_concurrent=2`` + a slowed cache-fill seam) so
+    structured 429s demonstrably occur; one replica is taken out of
+    rotation and shut down mid-storm.  Asserts: every read answered 200 or
+    cleanly shed 429 (reason ``read_overload`` + Retry-After), p99 read
+    latency bounded, cache hits visible on /metrics, every write terminal,
+    and the final response exactly matches the on-disk segment — never a
+    torn or stale one."""
+    import random as _random
+
+    overrides = {
+        "storage": {"store_images": True},
+        "service": {
+            "replicas": 2, "spool_shards": 8,
+            "replica_heartbeat_interval_s": 0.2,
+            "replica_stale_after_s": 1.5, "takeover_interval_s": 0.3,
+            "admission": {"max_queue_depth": 32},
+            "read": {"max_concurrent": 2, "retry_after_s": 1.0},
+        },
+    }
+    h1 = Harness(base, "read", sm_overrides=_merge(
+        dict(overrides), {"service": {"replica_id": "r1"}}))
+    h2 = Harness(base, "read", sm_overrides=_merge(
+        dict(overrides), {"service": {"replica_id": "r2"}}))
+    prev = failpoints.active_spec()
+    try:
+        # seed two datasets so cohorts span segments and tiles exist
+        seeds = []
+        for ds in ("read_a", "read_b"):
+            status, _hd, body = h1.submit(_msg(fx, "fast", ds))
+            _check(status == 202, f"read: seed submit shed ({status})")
+            seeds.append(body["msg_id"])
+        _wait_done(h1.root, seeds)
+        seg_a = h1.dir / "results" / "read_a" / "segment.npz"
+        _check(seg_a.exists(), "read: seed run published no segment")
+        npz = h1.dir / "results" / "read_a" / "ion_images.npz"
+        _check(npz.exists(), "read: seed run stored no ion images")
+        from sm_distributed_tpu.engine.storage import SearchResultsStore
+
+        _imgs, ions = SearchResultsStore.load_ion_images(npz)
+        _check(ions, "read: empty ion-image npz")
+        sf = ions[0][0]
+        ion = urllib.parse.quote(f"{ions[0][0]}|{ions[0][1]}", safe="")
+        paths = [
+            "/datasets",
+            "/datasets/read_a/annotations?order=msm&limit=2",
+            "/datasets/read_a/annotations?fdr=0.5",
+            "/datasets/read_b/annotations",
+            f"/annotations?sf={sf}",
+            f"/datasets/read_a/images/{ion}",
+        ]
+        # slow every cache fill so the 2-slot read admission demonstrably
+        # sheds under 6 concurrent readers (sleeps only on MISSES — hits
+        # stay fast, which is also what makes the p99 bound meaningful)
+        failpoints.configure("read.cache_fill=sleep:0.05")
+        targets = [h1.base, h2.base]
+        results: list[tuple[int, dict, bytes, float]] = []
+        res_lock = threading.Lock()
+        writes: list[str] = []
+
+        def _reader(seed: int) -> None:
+            rng = _random.Random(seed)
+            for _ in range(reads_each):
+                t = rng.choice(list(targets))
+                t0 = time.monotonic()
+                status, headers, raw = _http_raw(t, rng.choice(paths))
+                dt = time.monotonic() - t0
+                with res_lock:
+                    results.append((status, headers, raw, dt))
+                time.sleep(0.02)      # pace the storm across the replica kill
+
+        threads = [threading.Thread(target=_reader, args=(i,))
+                   for i in range(n_readers)]
+        for t in threads:
+            t.start()
+        # ~10% write plane: re-annotate read_a under the read storm — each
+        # store atomically republishes the segment beneath the readers
+        for i in range(n_writes):
+            status, _hd, body = h1.submit(
+                _msg(fx, "fast", "read_a", msg_id=f"rw{i}"))
+            _check(status == 202, f"read: write {i} shed ({status})")
+            writes.append(body["msg_id"])
+            time.sleep(0.15)
+            if i == n_writes // 2:
+                # replica loss mid-storm: out of rotation first (what a
+                # load balancer's health check does), a beat for issued
+                # requests to land, then drain — every in-flight read
+                # finishes, later reads route to r1
+                targets[:] = [h1.base]
+                time.sleep(0.3)
+                h2.shutdown()
+        for t in threads:
+            t.join(timeout=120.0)
+        failpoints.configure(None)
+        _wait_done(h1.root, writes)
+        # ---- asserts ----------------------------------------------------
+        statuses = sorted({s for s, _h, _r, _d in results})
+        _check(set(statuses) <= {200, 429},
+               f"read: non-clean read outcomes {statuses}")
+        sheds = [(h, r) for s, h, r, _d in results if s == 429]
+        _check(sheds, "read: admission squeeze produced no 429s")
+        for headers, raw in sheds:
+            body = json.loads(raw)
+            _check(body.get("reason") == "read_overload"
+                   and "retry_after_s" in body,
+                   f"read: unstructured shed body {body}")
+            _check("Retry-After" in headers,
+                   f"read: shed missing Retry-After: {headers}")
+        lats = sorted(d for s, _h, _r, d in results if s == 200)
+        _check(lats, "read: no successful reads")
+        p99 = lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+        _check(p99 <= p99_bound_s,
+               f"read: p99 read latency {p99:.3f}s > {p99_bound_s}s")
+        text = h1.metrics_text()
+        hits = sum(
+            float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+            if ln.startswith("sm_read_cache_hits_total{"))
+        _check(hits > 0, "read: no cache hits on /metrics")
+        _check("sm_read_requests_total" in text
+               and "sm_read_latency_seconds" in text,
+               "read: sm_read_* families missing from /metrics")
+        # freshness + integrity: the final response must be exactly the
+        # on-disk segment the last write published — never torn, never a
+        # stale pre-republish cache entry
+        from sm_distributed_tpu.engine.index import _load_file
+
+        seg = _load_file(seg_a)
+        status, _hd, raw = _http_raw(h1.base, "/datasets/read_a/annotations")
+        _check(status == 200, f"read: final read failed ({status})")
+        final = json.loads(raw)
+        _check(final["published_at"] == seg.published_at
+               and final["total"] == seg.n_rows,
+               f"read: served view (job {final['job_id']} at "
+               f"{final['published_at']}) != on-disk segment "
+               f"(job {seg.job_id} at {seg.published_at})")
+        n_reads = len(results)
+        print(f"  read: {n_reads} reads ({len(sheds)} shed 429, "
+              f"p99 {p99 * 1000:.0f}ms, {int(hits)} cache hits) + "
+              f"{n_writes + 2} writes over 2 replicas, r2 retired "
+              f"mid-storm; final view matches the on-disk segment")
+    finally:
+        failpoints.configure(prev)
+        h1.shutdown()
+        h2.shutdown()
+
+
+def _wait_done(root: Path, msg_ids: list[str],
+               timeout_s: float = 120.0) -> None:
+    """Spool-census wait (works across replicas, unlike one /jobs view)."""
+    deadline = time.time() + timeout_s
+    want = set(msg_ids)
+    while time.time() < deadline:
+        done = {p.stem for p in (root / "done").glob("*.json")}
+        if want <= done:
+            return
+        bad = {p.stem for p in (root / "failed").glob("*.json")} & want
+        if bad:
+            raise SweepError(f"read: writes dead-lettered: {sorted(bad)}")
+        time.sleep(0.05)
+    raise SweepError(f"read: writes never drained: "
+                     f"{sorted(want - done)}")
+
+
 # ------------------------------------------------------------------- driver
 def run_sweep(work: Path, smoke: bool = False,
-              elastic_only: bool = False) -> int:
+              elastic_only: bool = False, read_only: bool = False) -> int:
     # lock-order detection (ISSUE 9): instrument every lock the service
     # stack creates below and fail the sweep on an acquisition-order cycle
     # — the load mixes drive scheduler workers, dispatcher, watchdog,
@@ -934,6 +1116,9 @@ def run_sweep(work: Path, smoke: bool = False,
         if elastic_only:
             print("load sweep (elastic-fleet stage)")
             mix_elastic(work)
+        elif read_only:
+            print("load sweep (read-plane stage)")
+            mix_read(work, build_fixtures(work))
         else:
             fx = build_fixtures(work)
             h = Harness(work, "main")
@@ -953,6 +1138,7 @@ def run_sweep(work: Path, smoke: bool = False,
                 mix_device_fault(work, fx)
                 mix_disk(work, fx)
                 mix_replicas(work)
+                mix_read(work, fx)
                 mix_elastic(work)
         rep = lockorder.assert_no_cycles("load sweep")
         print(f"lock-order: no cycles ({rep['locks_instrumented']} locks, "
@@ -970,6 +1156,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--elastic", action="store_true",
                     help="run only the elastic-fleet mix (1→4→2 wave with "
                          "exactly-once + clean-drain asserts)")
+    ap.add_argument("--read", action="store_true",
+                    help="run only the read-plane mix (~90/10 read/write "
+                         "over two replicas, structured 429 sheds, p99 "
+                         "bound, cache-hit ratio, replica kill mid-storm)")
     ap.add_argument("--work", default=None)
     ap.add_argument("--keep", action="store_true")
     args = ap.parse_args(argv)
@@ -979,7 +1169,8 @@ def main(argv: list[str] | None = None) -> int:
     work = Path(args.work) if args.work else Path(
         tempfile.mkdtemp(prefix="sm_load_"))
     try:
-        return run_sweep(work, smoke=args.smoke, elastic_only=args.elastic)
+        return run_sweep(work, smoke=args.smoke, elastic_only=args.elastic,
+                         read_only=args.read)
     except SweepError as exc:
         print(f"load sweep FAILED: {exc}", file=sys.stderr)
         return 1

@@ -54,13 +54,17 @@ _BENCH_RATE_KEYS = ("value", "patterns_per_s", "pixels_per_s",
                     # multichip section (ISSUE 7): the N-chip sharded rate
                     # ("value" above), the same-run 1-chip reference, and
                     # the scaling ratio itself are all higher-is-better
-                    "single_chip_ions_per_s", "speedup_vs_single_chip")
+                    "single_chip_ions_per_s", "speedup_vs_single_chip",
+                    # ISSUE 16: the read-plane mixed cold/warm query rate
+                    "reads_per_s")
 _BENCH_TIME_KEYS = ("compile_s", "isocalc_s", "isocalc_cold_s",
                     "single_chip_compile_s",
                     # ISSUE 13: cleared-cache cold-start pins — the
                     # sentinel band-checks the COLD path, not just the
                     # warm headline
-                    "cold_compile_s", "first_annotation_cold_s")
+                    "cold_compile_s", "first_annotation_cold_s",
+                    # ISSUE 16: read-plane median query latency
+                    "read_p50_ms")
 # nested bench cases ride along ("multichip" appears on --devices N runs)
 _CASE_KEYS = ("scale", "desi", "multichip")
 

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Read-path smoke gate (ISSUE 16; wired into check_tier1.sh).
+
+Annotates the synthetic spheroid fixture through the REAL in-process
+annotation service (ion images stored), then proves the production read
+plane end to end over HTTP:
+
+1. ``GET /datasets`` lists the published dataset with its publish
+   metadata;
+2. a cold annotation query misses the cache and answers from the
+   columnar segment; the identical warm query is a cache **hit**
+   (``sm_read_cache_hits_total`` moves) and 20 warm repeats hold
+   **p50 < 50 ms**;
+3. the query result matches a brute-force pandas scan of the stored
+   ``annotations.parquet`` — same rows, same msm ordering (the segment
+   is a projection of the parquet, never a divergent copy);
+4. ``GET /datasets/<id>/images/<sf|adduct>`` returns bytes bit-identical
+   to a direct ``engine/png.py`` render of the stored npz array;
+5. ``GET /slo`` carries the ``read`` SLI with live attainment;
+6. a cross-dataset cohort query answers for the fixture's top formula.
+
+Exit 0 = gate passes.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import time
+import urllib.parse
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from scripts.load_sweep import (  # noqa: E402
+    Harness,
+    _http_raw,
+    _msg,
+    build_fixtures,
+)
+
+WARM_REPEATS = 20
+WARM_P50_BOUND_S = 0.050
+
+
+def fail(msg: str) -> int:
+    print(f"read_smoke: FAIL — {msg}", file=sys.stderr)
+    return 1
+
+
+def _get_json(base: str, path: str):
+    status, _hd, raw = _http_raw(base, path)
+    return status, json.loads(raw)
+
+
+def run(work: Path) -> int:
+    fx = build_fixtures(work)
+    h = Harness(work, "read_smoke",
+                sm_overrides={"storage": {"store_images": True}})
+    try:
+        # ---- annotate through the real service --------------------------
+        status, _hd, body = h.submit(_msg(fx, "fast", "spheroid"))
+        if status != 202:
+            return fail(f"submit returned {status}: {body}")
+        rows = h.wait_terminal([body["msg_id"]])
+        if rows[body["msg_id"]]["state"] != "done":
+            return fail(f"annotate job: {rows[body['msg_id']]}")
+
+        # ---- 1. dataset listing ----------------------------------------
+        status, listing = _get_json(h.base, "/datasets")
+        if status != 200 or [d["ds_id"] for d in listing["datasets"]] \
+                != ["spheroid"]:
+            return fail(f"/datasets: {status} {listing}")
+        if listing["datasets"][0]["n_rows"] < 1:
+            return fail(f"empty published segment: {listing}")
+
+        # ---- 2. cold miss, warm hit, warm p50 ---------------------------
+        q = "/datasets/spheroid/annotations?order=msm&dir=desc"
+        status, cold = _get_json(h.base, q)
+        if status != 200 or cold["total"] < 1:
+            return fail(f"cold query: {status} {cold}")
+        status, warm = _get_json(h.base, q)
+        if status != 200 or warm != cold:
+            return fail("warm query disagrees with cold query")
+        text = h.metrics_text()
+        if 'sm_read_cache_hits_total{kind="annotations"}' not in text:
+            return fail("warm query did not hit the cache "
+                        "(sm_read_cache_hits_total missing)")
+        lats = []
+        for _ in range(WARM_REPEATS):
+            t0 = time.perf_counter()
+            status, _w = _get_json(h.base, q)
+            lats.append(time.perf_counter() - t0)
+            if status != 200:
+                return fail(f"warm repeat returned {status}")
+        p50 = sorted(lats)[len(lats) // 2]
+        if p50 >= WARM_P50_BOUND_S:
+            return fail(f"warm p50 {p50 * 1000:.1f} ms >= "
+                        f"{WARM_P50_BOUND_S * 1000:.0f} ms bound")
+
+        # ---- 3. parity vs a brute-force pandas scan ---------------------
+        import pandas as pd
+
+        parquet = pd.read_parquet(
+            Path(h.sm_config.storage.results_dir) / "spheroid"
+            / "annotations.parquet")
+        got = [(r["sf"], r["adduct"], round(r["msm"], 9))
+               for r in cold["rows"]]
+        want = sorted(
+            ((r.sf, r.adduct, round(float(r.msm), 9))
+             for r in parquet.itertuples()),
+            key=lambda t: (t[2], t[0], t[1]), reverse=True)
+        if cold["total"] != len(parquet) or got != want[:len(got)]:
+            return fail(f"segment diverges from the parquet scan: "
+                        f"served {got[:3]}... expected {want[:3]}...")
+
+        # ---- 4. tile bytes bit-identical to a direct render -------------
+        from sm_distributed_tpu.engine.png import PngGenerator
+        from sm_distributed_tpu.engine.storage import SearchResultsStore
+
+        npz = Path(h.sm_config.storage.results_dir) / "spheroid" \
+            / "ion_images.npz"
+        if not npz.exists():
+            return fail("service stored no ion_images.npz")
+        images, ions = SearchResultsStore.load_ion_images(npz)
+        sf, adduct = ions[0]
+        ion_q = urllib.parse.quote(f"{sf}|{adduct}", safe="")
+        status, headers, png = _http_raw(
+            h.base, f"/datasets/spheroid/images/{ion_q}?k=0")
+        if status != 200:
+            return fail(f"tile GET returned {status}")
+        if headers.get("Content-Type") != "image/png":
+            return fail(f"tile Content-Type: {headers.get('Content-Type')}")
+        direct = PngGenerator().render(images[0, 0])
+        if png != direct:
+            return fail(f"tile bytes differ from the direct render "
+                        f"({len(png)} vs {len(direct)} bytes)")
+
+        # ---- 5. the read SLO is live ------------------------------------
+        status, slo = _get_json(h.base, "/slo")
+        read_slo = slo.get("slos", {}).get("read")
+        if status != 200 or read_slo is None:
+            return fail(f"/slo has no read SLI: {slo}")
+        if read_slo["count"] < WARM_REPEATS or \
+                read_slo.get("attainment") is None:
+            return fail(f"read SLI not accumulating: {read_slo}")
+
+        # ---- 6. cohort answers ------------------------------------------
+        status, cohort = _get_json(
+            h.base, f"/annotations?sf={urllib.parse.quote(sf)}")
+        if status != 200 or cohort["n_datasets"] != 1:
+            return fail(f"cohort query: {status} {cohort}")
+    finally:
+        h.shutdown()
+    print(f"read_smoke: OK — cold->warm cache hit, warm p50 "
+          f"{p50 * 1000:.1f} ms, parity vs parquet scan "
+          f"({cold['total']} rows), tile bit-identical "
+          f"({len(png)} bytes), read SLO attainment "
+          f"{read_slo['attainment']:.3f} over {read_slo['count']} reads")
+    return 0
+
+
+def main() -> int:
+    import shutil
+
+    work = Path(tempfile.mkdtemp(prefix="sm_read_smoke_"))
+    try:
+        return run(work)
+    except AssertionError as exc:
+        return fail(str(exc))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,9 +10,10 @@ to end:
    degrade level 1 — visible on ``/metrics``
    (``sm_disk_degrade_level``) and ``/debug/resources`` — and the next
    job completes GOLDEN with its trace writes dropped;
-3. more filler reaches the cache floor (level 2) and then the submit
-   floor: ``POST /submit`` sheds with a structured **507** +
-   ``Retry-After``;
+3. more filler reaches the cache floor (level 2), the read-cache floor
+   (level 3: read-path cache fills dropped, reads still answered) and
+   then the submit floor (level 4): ``POST /submit`` sheds with a
+   structured **507** + ``Retry-After``;
 4. freeing the space recovers the service without a restart (level 0,
    submits accepted, job completes);
 5. the bounded-retention GC keeps the spool under its caps: drained
@@ -72,6 +73,7 @@ def run(work: Path) -> int:
             "disk_budget_bytes": BUDGET,
             "trace_floor_bytes": 48 * MB,
             "cache_floor_bytes": 32 * MB,
+            "read_cache_floor_bytes": 24 * MB,
             "submit_floor_bytes": 16 * MB,
             "gc_interval_s": 0.2,
             "done_retention_age_s": 0.5,
@@ -125,11 +127,13 @@ def run(work: Path) -> int:
         if 'sm_disk_degraded_writes_total{kind="trace"}' not in text:
             return fail("trace-drop counter missing from /metrics")
 
-        # ---- 3. cache floor, then 507 submit shed -----------------------
+        # ---- 3. cache floor, read-cache floor, then 507 submit shed -----
         filler.write_bytes(b"\0" * (36 * MB))
         snap = _wait_level(h, 2)
+        filler.write_bytes(b"\0" * (44 * MB))
+        _wait_level(h, 3)               # read-path cache fills now dropped
         filler.write_bytes(b"\0" * (52 * MB))
-        _wait_level(h, 3)
+        _wait_level(h, 4)
         status, headers, body = h.submit(_msg(fx, "fast", "shedme"))
         if status != 507:
             return fail(f"expected 507 at the submit floor, got {status} "

@@ -339,6 +339,12 @@ class SearchResultsStore:
         for tmp, dst in tmps:
             tmp.replace(dst)
         n = self.index.index_ds(ds_id, job_id, bundle.annotations, ion_mzs)
+        # read-plane publish (ISSUE 16): swap the dataset's columnar read
+        # segment LAST, behind the same caller-held fence as the rest of the
+        # store — readers see the previous complete segment until this commits
+        from .index import publish_segment
+
+        publish_segment(d, ds_id, job_id, bundle.annotations, ion_mzs)
         logger.info("stored %d annotations for ds %s under %s", n, ds_id, d)
         return d
 
@@ -376,12 +382,19 @@ class SearchResultsStore:
         np.cumsum(counts, out=indptr[1:])
         cols = np.nonzero(nz)[1].astype(np.int32)
         vals = flat[nz].astype(np.float32)
-        np.savez_compressed(
-            d / "ion_images.npz",
-            data=vals, indices=cols, indptr=indptr,
-            shape=np.array([images.shape[0], images.shape[1], nrows, ncols]),
-            ions=np.array([f"{sf}|{adduct}" for sf, adduct in ions]),
-        )
+        # tmp + atomic rename: the tile service (ISSUE 16) reads this file
+        # under concurrent re-annotation — readers must see the previous
+        # complete npz or the new one, never a partial write
+        tmp = d / "ion_images.npz.tmp"
+        with open(tmp, "wb") as f:
+            np.savez_compressed(
+                f,
+                data=vals, indices=cols, indptr=indptr,
+                shape=np.array(
+                    [images.shape[0], images.shape[1], nrows, ncols]),
+                ions=np.array([f"{sf}|{adduct}" for sf, adduct in ions]),
+            )
+        tmp.replace(d / "ion_images.npz")
         return d / "ion_images.npz"
 
     @staticmethod

@@ -48,7 +48,14 @@ RabbitMQ's management UI):
 - ``GET /debug/devices``  the chip-level device-pool view (ISSUE 14):
   per-chip health state + fault strikes + quarantine evidence
   (``service/health.py``), lease holders, probe/quarantine/readmit/
-  host-eviction totals, and per-chip breaker states.
+  host-eviction totals, and per-chip breaker states;
+- ``GET /datasets`` / ``GET /datasets/<id>/annotations`` /
+  ``GET /annotations`` / ``GET /datasets/<id>/images/<sf_adduct>``  the
+  result read path (ISSUE 16, ``service/readpath.py``): dataset listing,
+  filtered/sorted/keyset-paginated annotation queries, cross-dataset
+  per-molecule cohorts, and PNG ion-image tiles — read-admission sheds
+  return a structured **429** with ``Retry-After``, independent of the
+  write-side admission.
 
 ``ThreadingHTTPServer`` keeps scrapes responsive while workers run; every
 handler is read-only except ``/submit`` (appends to ``pending/``) and
@@ -148,6 +155,14 @@ class AdminAPI:
                 self._reply(status, json.dumps(obj).encode(),
                             "application/json", headers)
 
+            def _reply_read(self, result) -> None:
+                """Render a ReadPath handler result: PNG bytes or JSON."""
+                status, body, headers = result
+                if isinstance(body, (bytes, bytearray)):
+                    self._reply(status, bytes(body), "image/png", headers)
+                else:
+                    self._reply_json(status, body, headers)
+
             def do_GET(self):
                 try:
                     url = urlparse(self.path)
@@ -186,6 +201,30 @@ class AdminAPI:
                         self._reply_json(status, body)
                     elif url.path == "/peers":
                         self._reply_json(200, api._peers())
+                    elif url.path == "/datasets" or url.path == "/annotations" \
+                            or (url.path.startswith("/datasets/")
+                                and url.path.strip("/").split("/")[2:3]
+                                in (["annotations"], ["images"])):
+                        rp = getattr(api.service, "readpath", None)
+                        if rp is None:
+                            self._reply_json(
+                                404, {"error": "read path not configured",
+                                      "reason": "not_found"})
+                            return
+                        q = parse_qs(url.query)
+                        parts = url.path.strip("/").split("/")
+                        if url.path == "/datasets":
+                            self._reply_read(rp.handle_datasets())
+                        elif url.path == "/annotations":
+                            self._reply_read(rp.handle_cohort(q))
+                        elif len(parts) == 3:
+                            self._reply_read(
+                                rp.handle_annotations(parts[1], q))
+                        elif len(parts) == 4:
+                            self._reply_read(
+                                rp.handle_tile(parts[1], parts[3], q))
+                        else:
+                            self._reply_json(404, {"error": "not found"})
                     elif (parts := url.path.strip("/").split("/"))[0] == \
                             "jobs" and len(parts) == 3 and parts[2] == "trace":
                         q = parse_qs(url.query)

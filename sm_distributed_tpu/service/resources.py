@@ -77,14 +77,19 @@ from ..utils import tracing
 from ..utils.config import ResourcesConfig, TracingConfig
 from ..utils.logger import logger
 
-# degrade levels (gauge sm_disk_degrade_level)
+# degrade levels (gauge sm_disk_degrade_level).  ISSUE 16 inserted
+# no_read_cache between the isocalc-cache and shed-submits levels: read-path
+# cache fills are shed BEFORE submits — losing cache warmth only costs
+# latency, while shedding submits loses work
 LEVEL_OK = 0
 LEVEL_NO_TRACES = 1
 LEVEL_NO_CACHE = 2
-LEVEL_SHED_SUBMITS = 3
+LEVEL_NO_READ_CACHE = 3
+LEVEL_SHED_SUBMITS = 4
 
 _LEVEL_NAMES = {LEVEL_OK: "ok", LEVEL_NO_TRACES: "no_traces",
                 LEVEL_NO_CACHE: "no_cache",
+                LEVEL_NO_READ_CACHE: "no_read_cache",
                 LEVEL_SHED_SUBMITS: "shed_submits"}
 
 # statvfs / level cache TTL: preflights sit on write paths — one stat
@@ -122,7 +127,9 @@ class ResourceGovernor:
                  trace_dir: str | Path | None = None,
                  cache_dir: str | Path | None = None,
                  tracing_cfg: TracingConfig | None = None,
-                 metrics=None, replica_id: str = ""):
+                 metrics=None, replica_id: str = "",
+                 read_cache_dir: str | Path | None = None,
+                 read_cache_max_bytes: int = 0):
         self.cfg = cfg
         self.tracing_cfg = tracing_cfg or TracingConfig()
         self.replica_id = replica_id
@@ -132,6 +139,11 @@ class ResourceGovernor:
         self.queue_root = Path(queue_root) if queue_root else None
         self.trace_dir = Path(trace_dir) if trace_dir else None
         self.cache_dir = Path(cache_dir) if cache_dir else None
+        # read-path tile cache (ISSUE 16): dir + byte cap flow from the
+        # server wiring (ReadPathConfig.cache_disk_max_bytes), not from
+        # ResourcesConfig — the read path owns its own sizing knob
+        self.read_cache_dir = Path(read_cache_dir) if read_cache_dir else None
+        self.read_cache_max_bytes = int(read_cache_max_bytes)
         self._lock = threading.Lock()
         self._used = 0                # bytes under the roots, last scan
         self._pending = 0             # preflighted-but-not-rescanned bytes
@@ -184,7 +196,7 @@ class ResourceGovernor:
                 ).set(self.cfg.disk_budget_bytes)
         m.gauge("sm_disk_degrade_level",
                 "Disk-pressure degrade level (0=ok 1=no traces 2=no cache "
-                "3=shed submits)").set(level)
+                "3=no read cache 4=shed submits)").set(level)
 
     def _count(self, family: str, key: str) -> None:
         m = self._metrics
@@ -240,6 +252,8 @@ class ResourceGovernor:
         cfg = self.cfg
         if rem < cfg.submit_floor_bytes:
             new = LEVEL_SHED_SUBMITS
+        elif rem < cfg.read_cache_floor_bytes:
+            new = LEVEL_NO_READ_CACHE
         elif rem < cfg.cache_floor_bytes:
             new = LEVEL_NO_CACHE
         elif rem < cfg.trace_floor_bytes:
@@ -302,6 +316,18 @@ class ResourceGovernor:
             self._degraded_writes["cache"] = \
                 self._degraded_writes.get("cache", 0) + 1
         self._count("degraded", "cache")
+        return False
+
+    def allow_read_cache_fill(self) -> bool:
+        """Read-path cache-fill gate (service/readpath.py): False = serve
+        the read from its source segment/npz without caching the result
+        (level >= 3).  Reads never shed here — only their cache warmth."""
+        if not self.enabled or self.level() < LEVEL_NO_READ_CACHE:
+            return True
+        with self._lock:
+            self._degraded_writes["read_cache"] = \
+                self._degraded_writes.get("read_cache", 0) + 1
+        self._count("degraded", "read_cache")
         return False
 
     def submits_shed(self) -> bool:
@@ -440,6 +466,18 @@ class ResourceGovernor:
                 list(d.glob("theor_peaks_*.npz")), cap)
         self._reap("cache", victims)
 
+    def _sweep_read_cache(self, now: float) -> None:
+        d = self.read_cache_dir
+        cap = self.read_cache_max_bytes
+        if d is None or not d.is_dir():
+            return
+        # aged fill tmps are always fair game; committed tiles only under
+        # the cap (oldest first — eviction just costs a re-render)
+        victims = self._aged(d.glob("*.tmp"), 3600.0, now)
+        if cap > 0:
+            victims += self._over_size_cap(list(d.glob("*.png")), cap)
+        self._reap("read_cache", victims)
+
     def _sweep_registry(self, now: float) -> None:
         root = self.queue_root
         age = self.cfg.registry_retention_age_s
@@ -460,6 +498,7 @@ class ResourceGovernor:
         self._sweep_traces(now)
         self._sweep_spool(now, owns_msg)
         self._sweep_cache(now)
+        self._sweep_read_cache(now)
         self._sweep_registry(now)
         self.rescan_usage()
         with self._lock:
@@ -492,6 +531,7 @@ class ResourceGovernor:
                 "floors_bytes": {
                     "trace": self.cfg.trace_floor_bytes,
                     "cache": self.cfg.cache_floor_bytes,
+                    "read_cache": self.cfg.read_cache_floor_bytes,
                     "submit": self.cfg.submit_floor_bytes,
                 },
                 "degraded_writes": dict(self._degraded_writes),

@@ -94,6 +94,7 @@ class AnnotationService:
         # the engine seams (checkpoints, results, publish, cache shards)
         # and the admission controller consult it without plumbing;
         # tracing's file gate makes trace appends the FIRST thing dropped.
+        read_cache_dir = Path(self.sm_config.work_dir) / "read_cache"
         self.resources = ResourceGovernor(
             self.sm_config.resources,
             work_dir=self.sm_config.work_dir,
@@ -102,9 +103,20 @@ class AnnotationService:
             trace_dir=self.trace_dir,
             cache_dir=Path(self.sm_config.work_dir) / "isocalc_cache",
             tracing_cfg=self.sm_config.tracing,
-            metrics=self.metrics, replica_id=cfg.replica_id)
+            metrics=self.metrics, replica_id=cfg.replica_id,
+            read_cache_dir=read_cache_dir,
+            read_cache_max_bytes=cfg.read.cache_disk_max_bytes)
         set_governor(self.resources)
         tracing.set_file_gate(self.resources.trace_gate)
+        # result read path (ISSUE 16, service/readpath.py): governed LRU +
+        # segment reader + tile renderer behind the GET endpoints; cache
+        # fills consult the governor's no-read-cache degrade level
+        from .readpath import ReadPath
+
+        self.readpath = ReadPath(
+            self.sm_config.storage.results_dir, cfg.read,
+            governor=self.resources, metrics=self.metrics, slo=self.slo,
+            disk_dir=read_cache_dir) if cfg.read.enabled else None
         # HBM-OOM adaptive-scoring telemetry (models/oom.py): events,
         # converged backoffs, and the learned safe batch on /metrics
         oom.attach_metrics(self.metrics)

@@ -318,8 +318,9 @@ def _rss_bytes() -> int | None:
 class SLOTracker:
     """Latency SLIs as histograms + attainment/error-budget reporting.
 
-    Three objectives (``SMConfig.telemetry.slo_*``), each "fraction of jobs
-    under T seconds >= target".  The scheduler records queue-wait at each
+    Four objectives (``SMConfig.telemetry.slo_*``), each "fraction of
+    observations under T seconds >= target".  The scheduler records
+    queue-wait at each
     job's FIRST attempt start and end-to-end latency at every terminal
     outcome; ``models/msm_basic.py`` notifies the first scored checkpoint
     group through its first-annotation observer list (the moment the first
@@ -342,6 +343,9 @@ class SLOTracker:
         self.h_e2e = registry.histogram(
             "sm_slo_e2e_seconds",
             "Submit -> terminal outcome, per job (all outcomes)")
+        self.h_read = registry.histogram(
+            "sm_slo_read_seconds",
+            "Read-path request latency (annotations/cohort/tile GETs)")
         self._lock = threading.Lock()
         self._submits: dict[str, float] = {}     # job_id -> submit epoch
         self._first_noted: set[str] = set()
@@ -374,6 +378,11 @@ class SLOTracker:
             self._first_noted.add(job_id)
         self.h_first_annotation.observe(max(0.0, time.time() - submit_ts))
 
+    def observe_read(self, seconds: float) -> None:
+        """Read-path seam (service/readpath.py): one served read — sheds
+        (429) are excluded; they are admission outcomes, not latency."""
+        self.h_read.observe(max(0.0, seconds))
+
     def observe_terminal(self, job_id: str, state: str,
                          submit_ts: float) -> None:
         """Scheduler seam: terminal outcome — close out the job."""
@@ -394,7 +403,8 @@ class SLOTracker:
                 ("queue_wait", self.h_queue_wait, self.cfg.slo_queue_wait_s),
                 ("first_annotation", self.h_first_annotation,
                  self.cfg.slo_first_annotation_s),
-                ("e2e", self.h_e2e, self.cfg.slo_e2e_s)):
+                ("e2e", self.h_e2e, self.cfg.slo_e2e_s),
+                ("read", self.h_read, self.cfg.slo_read_s)):
             attained, count = hist.fraction_below(objective_s)
             entry = {
                 "objective_s": objective_s,

@@ -299,6 +299,39 @@ class PrimeConfig:
 
 
 @dataclass(frozen=True)
+class ReadPathConfig:
+    """Result read path (ISSUE 16, service/readpath.py, docs/SERVICE.md
+    "Read path"): the queryable annotation index + ion-image tile service +
+    governed LRU cache behind the ``GET /datasets*`` endpoints.  Reads shed
+    independently of writes: more than ``max_concurrent`` in-flight reads
+    get a structured 429 + Retry-After, and cache fills stop (reads still
+    answer from the source segments) when the disk governor degrades past
+    the read-cache floor."""
+    enabled: bool = True                 # serve the read endpoints
+    cache_max_bytes: int = 64 << 20      # in-memory LRU result/tile cache
+                                         # byte cap (0 disables caching)
+    cache_max_entries: int = 1024        # ... entry cap
+    cache_disk_max_bytes: int = 128 << 20  # on-disk tile cache byte cap
+                                         # under <work_dir>/read_cache
+                                         # (0 disables the disk tier)
+    max_concurrent: int = 32             # in-flight read bound; excess reads
+                                         # shed with 429 (0 = unlimited)
+    retry_after_s: float = 1.0           # Retry-After hint on shed reads
+    page_size: int = 100                 # default annotations page length
+    page_size_max: int = 1000            # hard cap on ?limit=
+
+    def __post_init__(self):
+        if min(self.cache_max_bytes, self.cache_max_entries,
+               self.cache_disk_max_bytes, self.max_concurrent) < 0:
+            raise ValueError("read: cache/concurrency bounds must be >= 0")
+        if self.retry_after_s < 0:
+            raise ValueError("read: retry_after_s must be >= 0")
+        if not 0 < self.page_size <= self.page_size_max:
+            raise ValueError(
+                "read: need 0 < page_size <= page_size_max")
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """Annotation-service knobs (scheduler + failure policy + admin API) —
     the serving-side analog of the reference's rabbitmq/daemon settings.
@@ -402,6 +435,7 @@ class ServiceConfig:
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     prime: PrimeConfig = field(default_factory=PrimeConfig)
+    read: ReadPathConfig = field(default_factory=ReadPathConfig)
 
     def __post_init__(self):
         if self.workers <= 0 or self.max_attempts <= 0:
@@ -456,6 +490,7 @@ class TelemetryConfig:
     slo_queue_wait_s: float = 30.0       # submit -> first attempt start
     slo_first_annotation_s: float = 120.0  # submit -> first scored group
     slo_e2e_s: float = 600.0             # submit -> terminal outcome
+    slo_read_s: float = 0.25             # read request -> response (ISSUE 16)
     slo_target: float = 0.99
 
     def __post_init__(self):
@@ -463,7 +498,7 @@ class TelemetryConfig:
             raise ValueError(
                 "telemetry: sample_interval_s/timeseries_len must be positive")
         if min(self.slo_queue_wait_s, self.slo_first_annotation_s,
-               self.slo_e2e_s) <= 0:
+               self.slo_e2e_s, self.slo_read_s) <= 0:
             raise ValueError("telemetry: SLO thresholds must be positive")
         if not 0.0 < self.slo_target < 1.0:
             raise ValueError("telemetry: slo_target must be in (0, 1)")
@@ -513,6 +548,9 @@ class ResourcesConfig:
                                          # trace-file writes are dropped
     cache_floor_bytes: int = 16 << 20    # ... below which isocalc cache
                                          # shard writes are dropped
+    read_cache_floor_bytes: int = 12 << 20  # ... below which read-path
+                                         # result/tile cache fills stop
+                                         # (reads answer from source)
     submit_floor_bytes: int = 8 << 20    # ... below which POST /submit
                                          # sheds with 507 + Retry-After
     gc_interval_s: float = 30.0          # retention sweep + usage rescan
@@ -533,12 +571,14 @@ class ResourcesConfig:
                self.cache_retention_max_bytes) < 0:
             raise ValueError("resources: byte knobs must be >= 0")
         if not (self.trace_floor_bytes >= self.cache_floor_bytes
+                >= self.read_cache_floor_bytes
                 >= self.submit_floor_bytes >= 0):
             raise ValueError(
                 "resources: degrade floors must be ordered "
                 "trace_floor_bytes >= cache_floor_bytes >= "
-                "submit_floor_bytes >= 0 (traces drop first, then cache, "
-                "then submits)")
+                "read_cache_floor_bytes >= submit_floor_bytes >= 0 "
+                "(traces drop first, then isocalc cache, then read-cache "
+                "fills, then submits)")
         if self.gc_interval_s <= 0:
             raise ValueError("resources.gc_interval_s must be positive")
         if min(self.done_retention_age_s, self.failed_retention_age_s,
@@ -641,4 +681,5 @@ _DATACLASS_FIELDS = {
     ("ServiceConfig", "admission"): AdmissionConfig,
     ("ServiceConfig", "fleet"): FleetConfig,
     ("ServiceConfig", "prime"): PrimeConfig,
+    ("ServiceConfig", "read"): ReadPathConfig,
 }

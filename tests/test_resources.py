@@ -153,7 +153,8 @@ def test_enospc_at_trace_append_never_fails_the_pipeline(tmp_path):
 def _governor(tmp_path, **cfg_over) -> ResourceGovernor:
     cfg = ResourcesConfig(**{
         "disk_budget_bytes": 1_000_000, "trace_floor_bytes": 600_000,
-        "cache_floor_bytes": 400_000, "submit_floor_bytes": 200_000,
+        "cache_floor_bytes": 400_000, "read_cache_floor_bytes": 300_000,
+        "submit_floor_bytes": 200_000,
         **cfg_over})
     work = tmp_path / "work"
     work.mkdir(exist_ok=True)
@@ -176,11 +177,16 @@ def test_degrade_order_traces_then_cache_then_submits(tmp_path):
     assert g.level() == res_mod.LEVEL_NO_TRACES
     assert not g.trace_gate() and g.allow_cache() and not g.submits_shed()
 
-    _fill(tmp_path, 700_000)            # remaining 300k < 400k cache floor
+    _fill(tmp_path, 650_000)            # remaining 350k < 400k cache floor
     g.rescan_usage()
     assert g.level() == res_mod.LEVEL_NO_CACHE
     assert not g.trace_gate() and not g.allow_cache()
-    assert not g.submits_shed()
+    assert g.allow_read_cache_fill() and not g.submits_shed()
+
+    _fill(tmp_path, 750_000)            # remaining 250k < 300k read floor
+    g.rescan_usage()
+    assert g.level() == res_mod.LEVEL_NO_READ_CACHE
+    assert not g.allow_read_cache_fill() and not g.submits_shed()
 
     _fill(tmp_path, 900_000)            # remaining 100k < 200k submit floor
     g.rescan_usage()
@@ -193,6 +199,7 @@ def test_degrade_order_traces_then_cache_then_submits(tmp_path):
     snap = g.snapshot()
     assert snap["degraded_writes"]["trace"] >= 2
     assert snap["degraded_writes"]["cache"] >= 1
+    assert snap["degraded_writes"]["read_cache"] >= 1
 
 
 def test_preflight_denies_at_the_floor_and_tracks_pending(tmp_path):

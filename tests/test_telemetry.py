@@ -260,9 +260,10 @@ def _get(h, path: str) -> dict:
 
 def test_acceptance_slo_endpoint_reports_real_attainment(traced_service_job):
     h, _msg_id, _tid = traced_service_job
+    _get(h, "/datasets")             # one real read feeds the read SLI
     rep = _get(h, "/slo")
     slos = rep["slos"]
-    assert set(slos) == {"queue_wait", "first_annotation", "e2e"}
+    assert set(slos) == {"queue_wait", "first_annotation", "e2e", "read"}
     for name, entry in slos.items():
         assert entry["count"] >= 1, f"{name} histogram empty"
         assert entry["attainment"] is not None
