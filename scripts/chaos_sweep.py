@@ -277,6 +277,31 @@ SCENARIOS: list[Scenario] = [
              "fleet controller killed mid-spawn (no replica launched); the "
              "restarted controller repairs the fleet and the job completes "
              "exactly once"),
+    # --- pod-layer seams (ISSUE 17) ------------------------------------
+    # SM_DIST_SIMULATE=1 exercises the whole managed multi-host init path
+    # (settings resolution, retry ladder, identity) without a real
+    # coordinator — the raise at the first attempt is the coordinator-not-
+    # yet-up launch race; the backoff ladder retries and the job completes
+    # on the (simulated) pod runtime.  The real 2-process init is covered
+    # by tests/test_distributed.py.
+    Scenario("dist.initialize", "consume",
+             "dist.initialize=raise:ConnectionError@1",
+             "multi-host init loses the coordinator launch race; the "
+             "backoff ladder retries and converges to golden",
+             golden_sm=True,
+             env={"SM_DIST_SIMULATE": "1",
+                  "SM_COORDINATOR": "127.0.0.1:12355",
+                  "SM_NUM_PROCESSES": "2", "SM_PROCESS_ID": "0"},
+             sm={"backend": "jax_tpu",
+                 "parallel": {"init_backoff_s": 0.01}}),
+    Scenario("host.heartbeat", "consume", "host.heartbeat=raise:OSError@1",
+             "heartbeat-read fault inside the host watchdog's freshness "
+             "pass: remote beats count as missed for that pass but the "
+             "replica loop survives and the job completes golden "
+             "(whole-host eviction itself is proven by scripts/"
+             "host_chaos.py)",
+             sm={"service": {"host_watchdog_interval_s": 0.05,
+                             "host_stale_after_s": 0.5}}),
     # --- result read-plane seams (ISSUE 16) ----------------------------
     Scenario("index.segment_commit", "consume",
              "index.segment_commit=crash@1",

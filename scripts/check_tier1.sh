@@ -177,6 +177,17 @@ if ! env JAX_PLATFORMS=cpu python scripts/read_smoke.py; then
     exit 1
 fi
 
+# host-loss survival gate (ISSUE 17): a 2-host simulated pod (self +
+# one real child process as host h1) loses the whole child host SIGKILL
+# mid-sharded-job — the host watchdog evicts its chip range in one unit,
+# the in-flight job resumes from checkpoint on the surviving host with
+# BIT-IDENTICAL stored annotations, /peers + sm_pod_* metrics show the
+# eviction, and the returning host is readmitted half-open immediately
+if ! env JAX_PLATFORMS=cpu python scripts/host_chaos.py --smoke; then
+    echo "check_tier1: FAIL — host-loss survival gate failed" >&2
+    exit 1
+fi
+
 # replica failover smoke gate (ISSUE 8): 3 real scheduler replica
 # processes over one partitioned spool; killing one mid-score (and pausing
 # one into a fence race) must converge every job exactly-once to the

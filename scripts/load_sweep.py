@@ -42,7 +42,13 @@ serving invariants after each mix:
   mid-sweep; the survivors fence + take over its shards and the asserts
   are: every job terminal in ``done/`` exactly once, p99 queue-wait
   bounded, and tenant-hash-bucket fairness (no bucket's mean wait runs
-  away from the global median).
+  away from the global median);
+- **pod** (full matrix only, ISSUE 17): a simulated 2-host pod — four
+  replicas, two per named host (``SM_HOST_NAME``/``SM_PROCESS_ID``) —
+  loses host h1 WHOLE mid-sweep (both its replicas SIGKILLed at once).
+  All jobs terminal exactly once, p99 bounded, and the survivors' host
+  watchdogs demonstrably evicted the dead host
+  (``sm_pod_host_evictions_total``).
 
 Usage::
 
@@ -766,6 +772,152 @@ def mix_replicas(base: Path, n_jobs: int = 600, tenant_space: int = 10_000,
           f"worst bucket mean {worst:.2f}s")
 
 
+def mix_pod(base: Path, n_jobs: int = 240, p99_bound_s: float = 30.0) -> None:
+    """Pod host-loss wave (ISSUE 17; ROADMAP item 2).
+
+    A simulated 2-host pod: four bare scheduler replicas over one
+    partitioned spool, two per named host (``SM_HOST_NAME`` /
+    ``SM_PROCESS_ID`` — the launcher env contract), every replica running
+    the host watchdog over the shared registry's per-process beat groups.
+    Mid-sweep BOTH of host h1's replicas are SIGKILLed at once — a whole
+    host dying, not a lone replica crash.  Asserts: every job terminal in
+    ``done/`` exactly once (the survivors fence + take over the dead
+    host's shards), p99 queue-wait bounded despite half the pod gone, and
+    the survivors' exit metrics show the watchdog saw it
+    (``sm_pod_host_evictions_total`` >= 1,
+    ``sm_pod_process_up{process="1"}`` == 0)."""
+    import signal as _signal
+    import subprocess
+
+    rng = __import__("random").Random(17)
+    mix_dir = base / "pod"
+    queue_dir = mix_dir / "queue"
+    root = queue_dir / "sm_annotate"
+    sm = {
+        "backend": "numpy_ref",
+        "work_dir": str(mix_dir / "work"),
+        "storage": {"results_dir": str(mix_dir / "results")},
+        "service": {
+            "workers": 4, "poll_interval_s": 0.02, "job_timeout_s": 30.0,
+            "max_attempts": 2, "backoff_base_s": 0.05, "backoff_max_s": 0.2,
+            "backoff_jitter": 0.0, "heartbeat_interval_s": 0.2,
+            "stale_after_s": 1.0, "drain_timeout_s": 20.0, "http_port": 0,
+            "quarantine_after": 20,
+            "replicas": 4, "spool_shards": 16,
+            "replica_heartbeat_interval_s": 0.25,
+            "replica_stale_after_s": 1.0, "takeover_interval_s": 0.3,
+            # each replica's own 2-domain pool + host watchdog: process i
+            # ↔ domain i, so the survivors' watchdogs fence domain 1 when
+            # h1's beat group goes stale
+            "device_pool_size": 4, "device_pool_hosts": 2,
+            "host_watchdog_interval_s": 0.25, "host_stale_after_s": 1.0,
+        },
+    }
+    mix_dir.mkdir(parents=True, exist_ok=True)
+    sm_conf = mix_dir / "sm.json"
+    sm_conf.write_text(json.dumps(sm, indent=2))
+    from sm_distributed_tpu.engine.daemon import QueuePublisher
+
+    pub = QueuePublisher(queue_dir)
+    t_publish = time.time()
+    for i in range(n_jobs):
+        pub.publish({
+            "ds_id": f"pj{i}", "msg_id": f"pj{i:05d}",
+            "input_path": "null://", "tenant": f"t{rng.randrange(500)}",
+        })
+    script = str(REPO_ROOT / "scripts" / "replica_chaos.py")
+    placement = {"r0": ("h0", 0), "r1": ("h0", 0),
+                 "r2": ("h1", 1), "r3": ("h1", 1)}
+    procs = {}
+    logs = {}
+    for rid, (host, pid) in placement.items():
+        env = dict(__import__("os").environ)
+        env.pop("SM_FAILPOINTS", None)
+        env["SM_HOST_NAME"] = host
+        env["SM_PROCESS_ID"] = str(pid)
+        log = open(mix_dir / f"{rid}.log", "w")
+        logs[rid] = log
+        procs[rid] = subprocess.Popen(
+            [sys.executable, script, "--replica-serve", str(queue_dir),
+             str(sm_conf), "--replica-id", rid, "--bare",
+             "--null-sleep", "0.01", "--idle-exit", "2.0",
+             "--metrics-dump", str(mix_dir / "metrics" / f"{rid}.prom")],
+            env=env, stdout=log, stderr=log, cwd=str(REPO_ROOT))
+    victims = [rid for rid, (host, _p) in placement.items() if host == "h1"]
+    killed = False
+    deadline = time.time() + 300.0
+    try:
+        while time.time() < deadline:
+            done = len(list((root / "done").glob("*.json")))
+            if not killed and done >= n_jobs // 3:
+                # host h1 dies whole: every one of its replicas at once
+                for rid in victims:
+                    procs[rid].send_signal(_signal.SIGKILL)
+                killed = True
+                print(f"  pod: killed host h1 ({', '.join(victims)}) at "
+                      f"{done}/{n_jobs} done")
+            if done >= n_jobs:
+                break
+            if all(p.poll() is not None for p in procs.values()):
+                raise SweepError(f"pod: all exited at {done}/{n_jobs} done")
+            time.sleep(0.1)
+        else:
+            raise SweepError(
+                f"pod: did not drain in time "
+                f"({len(list((root / 'done').glob('*.json')))}/{n_jobs})")
+        _check(killed, "pod: kill point never reached")
+        drain_s = time.time() - t_publish
+        for rid, p in procs.items():
+            if rid not in victims:
+                p.wait(timeout=30)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        for log in logs.values():
+            log.close()
+    # ---- invariants from the drained spool -----------------------------
+    done_msgs = list((root / "done").glob("*.json"))
+    _check(len(done_msgs) == n_jobs, f"pod: {len(done_msgs)}/{n_jobs} done")
+    for state in ("pending", "running", "failed", "quarantine"):
+        left = list((root / state).glob("*.json"))
+        _check(not left, f"pod: {len(left)} messages left in {state}/")
+    waits = []
+    for p in done_msgs:
+        msg = json.loads(p.read_text())
+        w = (float(msg.get("service", {}).get("claimed_at", 0.0))
+             - float(msg["published_at"]))
+        _check(w >= 0, f"pod: negative queue wait on {p.name}")
+        waits.append(w)
+    waits.sort()
+    p50 = waits[len(waits) // 2]
+    p99 = waits[min(len(waits) - 1, int(len(waits) * 0.99))]
+    _check(p99 <= p99_bound_s,
+           f"pod: p99 queue wait {p99:.2f}s > bound {p99_bound_s}s")
+    # the survivors' watchdogs must have seen the host die
+    evictions = 0.0
+    saw_down = False
+    for rid, (host, _p) in placement.items():
+        if host != "h0":
+            continue
+        dump = mix_dir / "metrics" / f"{rid}.prom"
+        _check(dump.exists(), f"pod: survivor {rid} left no metrics dump")
+        text = dump.read_text()
+        for line in text.splitlines():
+            if line.startswith("sm_pod_host_evictions_total"):
+                evictions += float(line.rsplit(" ", 1)[1])
+            if line.startswith('sm_pod_process_up{process="1"} 0'):
+                saw_down = True
+    _check(evictions >= 1,
+           "pod: no survivor recorded sm_pod_host_evictions_total")
+    _check(saw_down,
+           'pod: no survivor exported sm_pod_process_up{process="1"} == 0')
+    print(f"  pod: {n_jobs} jobs over 2 hosts x 2 replicas, host h1 "
+          f"SIGKILLed whole mid-sweep; drain {drain_s:.1f}s, queue-wait "
+          f"p50 {p50:.2f}s p99 {p99:.2f}s, survivor host evictions "
+          f"{evictions:.0f}")
+
+
 def mix_elastic(base: Path, n_jobs: int = 420, p99_bound_s: float = 30.0) -> None:
     """Elastic-fleet wave (ISSUE 11 proof; ROADMAP item 2).
 
@@ -1099,8 +1251,8 @@ def _wait_done(root: Path, msg_ids: list[str],
 
 
 # ------------------------------------------------------------------- driver
-def run_sweep(work: Path, smoke: bool = False,
-              elastic_only: bool = False, read_only: bool = False) -> int:
+def run_sweep(work: Path, smoke: bool = False, elastic_only: bool = False,
+              read_only: bool = False, pod_only: bool = False) -> int:
     # lock-order detection (ISSUE 9): instrument every lock the service
     # stack creates below and fail the sweep on an acquisition-order cycle
     # — the load mixes drive scheduler workers, dispatcher, watchdog,
@@ -1116,6 +1268,9 @@ def run_sweep(work: Path, smoke: bool = False,
         if elastic_only:
             print("load sweep (elastic-fleet stage)")
             mix_elastic(work)
+        elif pod_only:
+            print("load sweep (pod host-loss stage)")
+            mix_pod(work)
         elif read_only:
             print("load sweep (read-plane stage)")
             mix_read(work, build_fixtures(work))
@@ -1138,6 +1293,7 @@ def run_sweep(work: Path, smoke: bool = False,
                 mix_device_fault(work, fx)
                 mix_disk(work, fx)
                 mix_replicas(work)
+                mix_pod(work)
                 mix_read(work, fx)
                 mix_elastic(work)
         rep = lockorder.assert_no_cycles("load sweep")
@@ -1160,6 +1316,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="run only the read-plane mix (~90/10 read/write "
                          "over two replicas, structured 429 sheds, p99 "
                          "bound, cache-hit ratio, replica kill mid-storm)")
+    ap.add_argument("--pod", action="store_true",
+                    help="run only the pod host-loss mix (2 hosts x 2 "
+                         "replicas, host h1 SIGKILLed whole mid-sweep, "
+                         "exactly-once + p99 + watchdog-eviction asserts)")
     ap.add_argument("--work", default=None)
     ap.add_argument("--keep", action="store_true")
     args = ap.parse_args(argv)
@@ -1170,7 +1330,7 @@ def main(argv: list[str] | None = None) -> int:
         tempfile.mkdtemp(prefix="sm_load_"))
     try:
         return run_sweep(work, smoke=args.smoke, elastic_only=args.elastic,
-                         read_only=args.read)
+                         read_only=args.read, pod_only=args.pod)
     except SweepError as exc:
         print(f"load sweep FAILED: {exc}", file=sys.stderr)
         return 1

@@ -110,15 +110,43 @@ def make_mesh(cfg: ParallelConfig, devices=None, hosts: int = 1) -> Mesh:
     return Mesh(dev_grid, (PIXELS_AXIS, FORMULAS_AXIS))
 
 
-def host_topology(device_indices, chips_per_host: int) -> dict[int, tuple]:
+def host_topology(device_indices, chips_per_host) -> dict[int, tuple]:
     """Group a lease's chip indices by host failure domain:
     ``{host: (chip, ...)}`` — what the fleet controller (and a sub-mesh
-    lease) uses to reason about host-level blast radius."""
-    cph = max(1, int(chips_per_host))
+    lease) uses to reason about host-level blast radius.
+
+    ``chips_per_host`` is either the legacy int (equal hosts of that many
+    chips) or, since ISSUE 17, explicit per-host ``(lo, hi)`` ranges
+    (``service/health.py::split_host_ranges``) so ragged pools attribute
+    every chip to the right host instead of the integer-division guess."""
+    ranges = None
+    if not isinstance(chips_per_host, int):
+        ranges = [(int(lo), int(hi)) for lo, hi in chips_per_host]
     out: dict[int, list[int]] = {}
     for i in device_indices or ():
-        out.setdefault(int(i) // cph, []).append(int(i))
+        i = int(i)
+        if ranges is None:
+            out.setdefault(i // max(1, int(chips_per_host)), []).append(i)
+            continue
+        for h, (lo, hi) in enumerate(ranges):
+            if lo <= i < hi:
+                out.setdefault(h, []).append(i)
+                break
+        else:
+            out.setdefault(len(ranges) - 1 if ranges else 0, []).append(i)
     return {h: tuple(sorted(v)) for h, v in sorted(out.items())}
+
+
+def global_device_order(devices=None) -> list:
+    """The pod-wide host-major device list: ``jax.devices()`` sorted by
+    ``(process_index, id)``.  JAX documents no enumeration order across
+    processes, so the pool's chip index -> Device mapping goes through this
+    one seam — stable under permuted enumeration, and chips of one process
+    form a contiguous index run (the host failure domain the pool's
+    ``hosts`` dimension names).  Unit-testable with fake device objects."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return sorted(devs, key=lambda d: (int(getattr(d, "process_index", 0)),
+                                       int(getattr(d, "id", 0))))
 
 
 def lease_devices(device_indices) -> list | None:
@@ -126,17 +154,25 @@ def lease_devices(device_indices) -> list | None:
     jax Device objects for a sub-mesh.
 
     ``None`` -> ``None`` (the caller meshes over ALL local devices, the
-    pre-pool behavior).  Indices beyond the visible device count — a
-    simulated pool larger than the host, e.g. the CI smoke's 8-chip pool on
-    a smaller box — are dropped with a warning; an empty result falls back
-    to ``None`` rather than failing the job over a telemetry-grade
-    mismatch.
+    pre-pool behavior).  In a multi-process runtime the pool indexes the
+    GLOBAL host-major order (``global_device_order``) — a lease's chips may
+    live in other processes (ISSUE 17); single-process keeps the local
+    list.  Indices beyond the visible device count — a simulated pool
+    larger than the host, e.g. the CI smoke's 8-chip pool on a smaller box
+    — are dropped with a warning; an empty result falls back to ``None``
+    rather than failing the job over a telemetry-grade mismatch.
     """
     if device_indices is None:
         return None
     from ..utils.logger import logger
 
-    devs = jax.local_devices()
+    try:
+        multi = jax.process_count() > 1
+    except Exception as exc:  # pragma: no cover - uninitialized backend
+        logger.debug("lease_devices: jax backend not up (%s); "
+                     "assuming single-process", exc)
+        multi = False
+    devs = global_device_order() if multi else jax.local_devices()
     picked = [devs[i] for i in device_indices if 0 <= int(i) < len(devs)]
     if len(picked) < len(list(device_indices)):
         logger.warning(

@@ -774,12 +774,14 @@ def make_jax_backend(ds: SpectralDataset, ds_config: DSConfig,
                                     "device_pool_hosts", 1)))
     if devices is not None and device_indices is not None and pool_hosts > 1:
         from ..service.device_pool import resolve_pool_size
+        from ..service.health import split_host_ranges
         from .mesh import host_topology
 
+        # explicit per-host ranges (ISSUE 17): ragged pools attribute every
+        # chip to its real host instead of skipping topology entirely
         pool_size = resolve_pool_size(sm_config.service)
-        if pool_size % pool_hosts == 0:
-            hosts = max(1, len(host_topology(
-                device_indices, pool_size // pool_hosts)))
+        hosts = max(1, len(host_topology(
+            device_indices, split_host_ranges(pool_size, pool_hosts))))
     if devices is not None and len(devices) == 1:
         from ..models.msm_jax import JaxBackend
 

@@ -43,7 +43,7 @@ import threading
 import time
 
 from ..utils.logger import logger
-from .health import HealthTracker
+from .health import HealthTracker, host_of_ranges, split_host_ranges
 
 
 class DeviceLease:
@@ -113,20 +113,19 @@ class DevicePool:
         self.size = int(size)
         self.max_bypass = max(0, int(max_bypass))
         # host dimension (ISSUE 11): the pool's chips split into `hosts`
-        # equal failure domains — the jax.distributed host×chip topology,
+        # failure domains — the jax.distributed host×chip topology,
         # simulated on CPU.  Grants PREFER a run within one host (a
         # single-host sub-mesh has no cross-host collectives and dies with
         # exactly one host); a lease wider than a host spans hosts and
-        # reports them.  A non-dividing host count degrades to 1 host
-        # rather than failing the pool — topology is an optimization.
-        hosts = max(1, int(hosts))
-        if self.size % hosts:
-            logger.warning(
-                "device pool: %d hosts does not divide %d chips; treating "
-                "the pool as a single host", hosts, self.size)
-            hosts = 1
-        self.hosts = hosts
-        self.chips_per_host = self.size // hosts
+        # reports them.  Since ISSUE 17 the split is EXPLICIT per-host
+        # ranges (split_host_ranges warns on ragged configs) instead of
+        # silently degrading a non-dividing host count to one host.
+        self.host_ranges = split_host_ranges(self.size, max(1, int(hosts)))
+        self.hosts = len(self.host_ranges)
+        self.chips_per_host = self.size // self.hosts   # legacy accessor
+        self._host_of = host_of_ranges(self.host_ranges)
+        self._host_starts = frozenset(lo for lo, _ in self.host_ranges)
+        self._max_host_chips = max(hi - lo for lo, hi in self.host_ranges)
         # per-chip health (ISSUE 14, service/health.py): quarantined chips
         # are excluded from grants, granted chips are lease-time probed,
         # and a half-open re-probe readmits recovered chips.  The tracker
@@ -202,7 +201,7 @@ class DevicePool:
 
     def host_of(self, i: int) -> int:
         """Host failure domain of chip index ``i``."""
-        return int(i) // self.chips_per_host
+        return self._host_of[int(i)]
 
     def snapshot(self) -> dict:
         """One point-in-time view (telemetry ring / debugging)."""
@@ -211,7 +210,7 @@ class DevicePool:
             per_host = [0] * self.hosts
             for i, o in enumerate(self._owner):
                 if o is not None:
-                    per_host[i // self.chips_per_host] += 1
+                    per_host[self._host_of[i]] += 1
             return {
                 "size": self.size,
                 "hosts": self.hosts,
@@ -242,7 +241,7 @@ class DevicePool:
         if healthy_total <= 0:
             return None
         n_eff = min(n, healthy_total)
-        if self.hosts > 1 and n_eff <= self.chips_per_host:
+        if self.hosts > 1 and n_eff <= self._max_host_chips:
             start = self._scan_run(n_eff, True, quarantined)
             if start is not None:
                 return tuple(range(start, start + n_eff))
@@ -261,8 +260,7 @@ class DevicePool:
         run = 0
         for i in range(self.size):
             if self._owner[i] is None and i not in quarantined:
-                if within_host and run and \
-                        i % self.chips_per_host == 0:
+                if within_host and run and i in self._host_starts:
                     run = 0           # a host boundary breaks the run
                 run += 1
             else:

@@ -50,6 +50,7 @@ mid-spawn), plus the scheduler-side ``drain.handoff`` and
 
 from __future__ import annotations
 
+import inspect
 import json
 import subprocess
 import threading
@@ -224,6 +225,7 @@ class _Child:
     rid: str
     proc: subprocess.Popen
     spawned_at: float
+    host: str = ""                       # named pod host it was placed on
     registered: bool = False             # first registry heartbeat seen
     draining: bool = False
     drain_requested_at: float = 0.0
@@ -251,13 +253,33 @@ class FleetController:
     def __init__(self, queue_dir: str | Path, cfg: FleetConfig,
                  service_cfg: ServiceConfig, spawn,
                  signals=None, metrics=None, self_replica_id: str | None = None,
-                 queue: str = QUEUE_ANNOTATE, replica_prefix: str = "fr"):
+                 queue: str = QUEUE_ANNOTATE, replica_prefix: str = "fr",
+                 hosts=None, warm_host=None):
         self.root = Path(queue_dir) / queue
         self.cfg = cfg
         self.service_cfg = service_cfg
         self.spawn = spawn
         self.self_replica_id = self_replica_id
         self.replica_prefix = replica_prefix
+        # host-aware placement (ISSUE 17): named pod hosts replicas are
+        # spread over, least-loaded first.  A 2-arg spawn factory receives
+        # (rid, host); the legacy 1-arg shape keeps working (host-blind).
+        # warm_host(host) runs ONCE before the first replica lands on each
+        # new host — the per-host primer warm-up seam (its XLA cache is
+        # cold until something compiles there).
+        self.hosts = tuple(str(h) for h in hosts or ())
+        self.warm_host = warm_host
+        self._warmed_hosts: set[str] = set()
+        self._spawn_takes_host = False
+        try:
+            params = list(inspect.signature(spawn).parameters.values())
+            self._spawn_takes_host = (
+                any(p.kind == p.VAR_POSITIONAL for p in params)
+                or len([p for p in params
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD)]) >= 2)
+        except (TypeError, ValueError):
+            pass
         self.registry = ReplicaRegistry(
             self.root, self_replica_id or "fleet-controller",
             stale_after_s=service_cfg.replica_stale_after_s)
@@ -317,6 +339,7 @@ class FleetController:
                 "pid": c.proc.pid, "registered": c.registered,
                 "draining": c.draining,
                 "exited": c.proc.poll(),
+                **({"host": c.host} if c.host else {}),
             } for rid, c in self._children.items()}
             state = self._state
             events = dict(self.scale_events)
@@ -339,28 +362,57 @@ class FleetController:
         self._next_ordinal += 1
         return rid
 
+    def _pick_host_locked(self) -> str:
+        """Least-loaded named host (caller holds the lock): spread replicas
+        over the pod's hosts; ties break toward the earlier name so
+        placement is deterministic."""
+        if not self.hosts:
+            return ""
+        load = {h: 0 for h in self.hosts}
+        for c in self._children.values():
+            if c.host in load and c.proc.poll() is None:
+                load[c.host] += 1
+        return min(self.hosts, key=lambda h: (load[h], self.hosts.index(h)))
+
     def _scale_up(self, now: float) -> None:
         with self._lock:
             rid = self._new_rid_locked()
+            host = self._pick_host_locked()
+        if host and host not in self._warmed_hosts and \
+                self.warm_host is not None:
+            # per-host primer warm-up (ISSUE 17): the first replica placed
+            # on a host pays that host's cold XLA cache — warm it before
+            # the replica takes traffic; a warm-up failure is logged, not
+            # fatal (the replica just compiles on first use)
+            try:
+                self.warm_host(host)
+            except Exception:
+                logger.warning("fleet: primer warm-up for host %s failed",
+                               host, exc_info=True)
+        if host:
+            self._warmed_hosts.add(host)
         # the controller-killed-mid-spawn seam: a crash here loses only
         # the controller — no replica, no claims; the restarted controller
         # re-reads the registry and repairs the fleet
         failpoint(FP_FLEET_SPAWN)
         try:
-            proc = self.spawn(rid)
+            proc = (self.spawn(rid, host) if self._spawn_takes_host
+                    else self.spawn(rid))
         except OSError as exc:
             logger.error("fleet: spawn of %s failed: %s", rid, exc)
             if self._m_spawn_fail is not None:
                 self._m_spawn_fail.inc()
             return
         with self._lock:
-            self._children[rid] = _Child(rid=rid, proc=proc, spawned_at=now)
+            self._children[rid] = _Child(rid=rid, proc=proc, spawned_at=now,
+                                         host=host)
             self.scale_events["up"] += 1
         if self._m_scale is not None:
             self._m_scale.labels(direction="up").inc()
-        tracing.event("fleet.scale", direction="up", rid=rid)
-        logger.info("fleet: scale UP — spawned replica %s (pid %d)",
-                    rid, proc.pid)
+        tracing.event("fleet.scale", direction="up", rid=rid,
+                      **({"host": host} if host else {}))
+        logger.info("fleet: scale UP — spawned replica %s (pid %d%s)",
+                    rid, proc.pid, f" on host {host}" if host else "")
 
     def _pending_spawns_locked(self) -> int:
         """Children spawned but not yet registered (still importing / warming
@@ -567,11 +619,17 @@ def serve_spawn(queue_dir: str | Path, sm_config_path: str | Path,
     import os
     import sys
 
-    def _spawn(rid: str) -> subprocess.Popen:
+    def _spawn(rid: str, host: str = "") -> subprocess.Popen:
         cmd = [sys.executable, "-m", "sm_distributed_tpu.engine.cli",
                "serve", str(queue_dir), "--sm-config", str(sm_config_path),
                "--replica-id", rid, "--port", "0", *extra_args]
-        return subprocess.Popen(cmd, env=env or dict(os.environ))
+        child_env = dict(env) if env is not None else dict(os.environ)
+        if host:
+            # named-host placement (ISSUE 17): the replica's pod identity
+            # — process_identity() reads SM_HOST_NAME — so its beats group
+            # under the right host for the watchdog
+            child_env["SM_HOST_NAME"] = host
+        return subprocess.Popen(cmd, env=child_env)
 
     return _spawn
 

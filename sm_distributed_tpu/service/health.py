@@ -106,6 +106,40 @@ def _device_probe(chip: int) -> tuple[bool, str]:
     return bool(np.array_equal(back, sent)), "device"
 
 
+def split_host_ranges(size: int, hosts: int) -> tuple[tuple[int, int], ...]:
+    """Explicit per-host chip ranges ``((lo, hi), ...)`` — the ISSUE 17
+    replacement for the ``chips_per_host = size // hosts`` guess, which
+    silently attributed a ragged pool's trailing chips to the WRONG host
+    (``7 // (7 // 2)`` puts chip 6 on a third, nonexistent host).  The
+    split is as even as possible: the first ``size % hosts`` hosts get one
+    extra chip.  Ragged configs are legal but warned — real pods are
+    rectangular, so raggedness usually means a typo'd pool size; a host
+    count exceeding the pool clamps to one chip per host."""
+    size, hosts = max(1, int(size)), max(1, int(hosts))
+    if hosts > size:
+        logger.warning(
+            "device health: %d hosts for a %d-chip pool — clamping to "
+            "%d single-chip host domain(s)", hosts, size, size)
+        hosts = size
+    base, extra = divmod(size, hosts)
+    if extra:
+        logger.warning(
+            "device health: %d chips split raggedly over %d hosts (%d "
+            "host(s) get %d chips, %d get %d) — check the pool size",
+            size, hosts, extra, base + 1, hosts - extra, base)
+    ranges, lo = [], 0
+    for h in range(hosts):
+        hi = lo + base + (1 if h < extra else 0)
+        ranges.append((lo, hi))
+        lo = hi
+    return tuple(ranges)
+
+
+def host_of_ranges(ranges) -> list[int]:
+    """Flat chip -> host lookup table for ``split_host_ranges`` output."""
+    return [h for h, (lo, hi) in enumerate(ranges) for _ in range(hi - lo)]
+
+
 def _parse_sim_bad(text: str | None) -> frozenset[int]:
     if not text:
         return frozenset()
@@ -142,8 +176,13 @@ class HealthTracker:
                  host_evict_fraction: float = 0.75,
                  probe_fn=None):
         self.size = int(size)
-        self.hosts = max(1, int(hosts))
-        self.chips_per_host = max(1, self.size // self.hosts)
+        # explicit per-host chip ranges (ISSUE 17 satellite): the old
+        # `size // hosts` integer division misattributed a ragged pool's
+        # trailing chips; host_ranges is the single source of truth for
+        # chip -> host everywhere in this tracker
+        self.host_ranges = split_host_ranges(self.size, hosts)
+        self.hosts = len(self.host_ranges)
+        self._host_of = host_of_ranges(self.host_ranges)
         self.probe_on_lease = bool(probe_on_lease)
         self.fault_quarantine = max(1, int(fault_quarantine))
         self.reprobe_after_s = float(reprobe_after_s)
@@ -240,7 +279,7 @@ class HealthTracker:
             chips = [{
                 "device": i,
                 "state": self._state[i],
-                "host": i // self.chips_per_host,
+                "host": self._host_of[i],
                 "faults": self._faults[i],
                 **({"quarantined_at": round(self._quarantined_at[i], 3),
                     "reason": self._reason[i]}
@@ -351,7 +390,7 @@ class HealthTracker:
         tracing.event("device_quarantine", device=chip, reason=reason[:300])
         record_recovery("device.quarantine")
         if not evicting_host:
-            self._check_host_evict(chip // self.chips_per_host)
+            self._check_host_evict(self._host_of[chip])
         return True
 
     def _check_host_evict(self, host: int) -> None:
@@ -362,9 +401,9 @@ class HealthTracker:
         time."""
         if self.hosts <= 1 or self.host_evict_fraction >= 1.0:
             return
-        lo, hi = host * self.chips_per_host, (host + 1) * self.chips_per_host
+        lo, hi = self.host_ranges[host]
         with self._lock:
-            members = range(lo, min(hi, self.size))
+            members = range(lo, hi)
             quarantined = [i for i in members
                            if self._state[i] == STATE_QUARANTINED]
             remaining = [i for i in members
@@ -376,10 +415,26 @@ class HealthTracker:
                      "quarantined >= %.0f%%)", host, len(quarantined),
                      len(quarantined) + len(remaining),
                      100 * self.host_evict_fraction)
+        self.evict_host(host, f"host {host} evicted "
+                              f"({len(quarantined)} chips out)")
+
+    def evict_host(self, host: int, reason: str) -> list[int]:
+        """Fence a WHOLE host failure domain in one unit (ISSUE 17: the
+        scheduler's host watchdog calls this when every process heartbeat
+        from the host went stale — a dead process takes all its chips with
+        it).  The last-healthy-chip refusal still applies per chip, so
+        evicting the final surviving host leaves one chip in service.
+        Returns the chips newly quarantined; idempotent."""
+        if not 0 <= host < self.hosts:
+            return []
+        lo, hi = self.host_ranges[host]
+        with self._lock:
+            remaining = [i for i in range(lo, hi)
+                         if self._state[i] != STATE_QUARANTINED]
+        if not remaining:
+            return []
         evicted = [c for c in remaining
-                   if self._quarantine(c, f"host {host} evicted "
-                                          f"({len(quarantined)} chips out)",
-                                      evicting_host=True)]
+                   if self._quarantine(c, reason, evicting_host=True)]
         if evicted:
             with self._lock:
                 self.host_evictions_total += 1
@@ -387,6 +442,22 @@ class HealthTracker:
             record_recovery("device.host_evict")
             if self._m_evictions is not None:
                 self._m_evictions.inc()
+        return evicted
+
+    def host_returned(self, host: int) -> list[int]:
+        """An evicted host's process is heartbeating again: zero the
+        re-probe cooldown for its quarantined chips so the next half-open
+        pass (``reprobe_due``) readmits them immediately instead of
+        waiting out ``reprobe_after_s``.  Returns the chips made due."""
+        if not 0 <= host < self.hosts:
+            return []
+        lo, hi = self.host_ranges[host]
+        with self._lock:
+            due = [c for c in range(lo, hi)
+                   if self._state[c] == STATE_QUARANTINED]
+            for c in due:
+                self._quarantined_at[c] = 0.0
+        return due
 
     # --------------------------------------------------------------- probes
     def probe_chips(self, chips) -> list[int]:

@@ -415,6 +415,23 @@ class ReplicaRegistry:
             out.append(rec)
         return out
 
+    def peers_by_process(self) -> dict[int, list[dict]]:
+        """Peers grouped by pod process id (ISSUE 17): the scheduler's beat
+        summaries gossip ``process_id``/``host`` since the pod layer, so a
+        whole host's replicas form one group — the host watchdog's unit of
+        liveness.  Peers without a process id (old replicas, bare tools)
+        are omitted rather than guessed."""
+        groups: dict[int, list[dict]] = {}
+        for p in self.peers():
+            pid = p.get("process_id")
+            if pid is None:
+                continue
+            try:
+                groups.setdefault(int(pid), []).append(p)
+            except (TypeError, ValueError):
+                continue
+        return groups
+
     def alive(self) -> set[str]:
         """Replica ids with a fresh heartbeat (always includes self)."""
         out = {self.replica_id}

@@ -72,6 +72,7 @@ from ..engine.daemon import (
     sweep_orphan_tmp,
 )
 from ..models import faults
+from ..parallel.distributed import process_identity
 from ..utils import tracing
 from ..utils.cancel import CancelToken, DeadlineExceededError, JobCancelledError
 from ..utils.config import ServiceConfig
@@ -105,6 +106,11 @@ FP_RETIRE_ACK = register_failpoint(
     "between a drained replica going idle and its retire ack write (a "
     "crash here leaves the ack unwritten; the controller falls back to "
     "process-exit + registry staleness)")
+FP_HOST_HEARTBEAT = register_failpoint(
+    "host.heartbeat",
+    "inside the host watchdog's freshness pass over the registry's per-"
+    "process beat groups (raise here counts every REMOTE process's beats "
+    "as missed — the whole-host eviction path without killing a process)")
 
 PRIORITY_CLASSES = {"high": 0, "normal": 1, "low": 2}
 
@@ -344,6 +350,16 @@ class JobScheduler:
         # replicas=1 and no peer heartbeats this degenerates to the old
         # single-owner behavior (the replica owns every shard).
         self.replica_id = self.cfg.replica_id
+        # pod identity (ISSUE 17): this scheduler process's (process_id,
+        # host), stamped into tracing records, registry beats (the host
+        # watchdog's grouping key), telemetry samples, and GET /peers
+        self.identity = process_identity()
+        tracing.set_process(self.identity["process_id"],
+                            self.identity["host"])
+        # host-watchdog memory: host domains currently evicted for missed
+        # process beats.  Replica-loop-only state (single writer) — not in
+        # _GUARDED_BY for the same reason _owned/_draining are excluded.
+        self._evicted_hosts: set[int] = set()
         self.registry = ReplicaRegistry(
             self.root, self.replica_id,
             stale_after_s=self.cfg.replica_stale_after_s)
@@ -422,6 +438,21 @@ class JobScheduler:
             "sm_replica_fenced_claims_total",
             "Local claims abandoned because a peer fenced them out",
             ("replica",))
+        # pod-level families (ISSUE 17): what the host watchdog observes
+        # and does, per pod process
+        self.m_pod_processes = m.gauge(
+            "sm_pod_processes",
+            "Distinct pod processes observed in the replica registry's "
+            "beat groups")
+        self.m_pod_process_up = m.gauge(
+            "sm_pod_process_up",
+            "1 while the pod process's registry beat group is fresh, per "
+            "process", ("process",))
+        self.m_pod_host_evictions = m.counter(
+            "sm_pod_host_evictions_total",
+            "Host domains evicted by the watchdog after missed process "
+            "heartbeats")
+        self.m_pod_host_evictions.inc(0)
         m.add_collector(self._collect_queue_depths)
         m.add_collector(self._collect_replicas)
 
@@ -562,6 +593,9 @@ class JobScheduler:
         return {
             "replica_id": self.replica_id,
             "epoch": self.epoch,
+            "process_id": self.identity["process_id"],
+            "host": self.identity["host"],
+            "evicted_hosts": sorted(self._evicted_hosts),
             "shards": self.cfg.spool_shards,
             "owned": sorted(self._owned),
             "fenced_claims": self._fenced_count,
@@ -1024,6 +1058,7 @@ class JobScheduler:
         kind = ("deadline" if reason.startswith("deadline") else
                 "stalled" if reason.startswith("stalled") else
                 "fenced" if reason.startswith("fenced") else
+                "host_evicted" if reason.startswith("host") else
                 "user" if "user" in reason else "timeout")
         if delivered:
             with self._records_lock:
@@ -1337,10 +1372,94 @@ class JobScheduler:
         /peers``) can approximate global quotas and shed decisions."""
         s: dict = {"owned": sorted(self._owned), "workers": self.cfg.workers,
                    "fenced_claims": self._fenced_count,
-                   "draining": self._draining}
+                   "draining": self._draining,
+                   # pod identity (ISSUE 17): the host watchdog groups
+                   # peers by process_id to detect whole-host death
+                   "process_id": self.identity["process_id"],
+                   "host": self.identity["host"]}
         if self.admission is not None:
             s["admission"] = self.admission.stats()
         return s
+
+    # -------------------------------------------------------- host watchdog
+    def _host_watchdog(self, now: float) -> None:
+        """Missed process heartbeats → whole-host eviction → mesh shrink
+        (ISSUE 17 tentpole).  Every pod process heartbeats the shared
+        registry with its ``process_id``; a process whose EVERY beat is
+        older than ``host_stale_after_s`` is declared dead.  Its chip range
+        (process ``i`` ↔ pool host domain ``i``) is fenced in one unit
+        (``HealthTracker.evict_host`` composing with PR 14 quarantine),
+        and in-flight attempts holding any of those chips are cancelled
+        into the normal retry path — the re-leased attempt resumes from
+        checkpoint on the shrunken cross-host mesh.  A returning process
+        (fresh beats again) zeroes its chips' re-probe cooldown so the
+        half-open pass readmits them immediately."""
+        health = self.device_pool.health
+        groups = self.registry.peers_by_process()
+        beats_ok = True
+        try:
+            failpoint(FP_HOST_HEARTBEAT)
+        except Exception as exc:
+            beats_ok = False
+            logger.warning("host watchdog: heartbeat read failed (%s) — "
+                           "treating remote process beats as missed", exc)
+        my_pid = self.identity["process_id"]
+        stale = self.cfg.host_stale_after_s
+        if self.metrics:
+            self.m_pod_processes.set(len(groups) or 1)
+        for pid, members in sorted(groups.items()):
+            fresh = pid == my_pid or (beats_ok and any(
+                float(m.get("age_s", float("inf"))) <= stale
+                for m in members))
+            host_name = next((str(m.get("host")) for m in members
+                              if m.get("host")), f"process-{pid}")
+            if self.metrics:
+                self.m_pod_process_up.labels(process=str(pid)).set(
+                    1 if fresh else 0)
+            if not fresh and pid not in self._evicted_hosts and \
+                    0 <= pid < health.hosts:
+                self._evict_host(pid, host_name, members)
+            elif fresh and pid in self._evicted_hosts:
+                self._evicted_hosts.discard(pid)
+                made_due = health.host_returned(pid)
+                tracing.event("host_return", host=pid, name=host_name,
+                              chips=made_due)
+                logger.warning(
+                    "host watchdog: host %s (process %d) is heartbeating "
+                    "again — %d chip(s) made due for half-open re-probe",
+                    host_name, pid, len(made_due))
+
+    def _evict_host(self, pid: int, host_name: str, members: list) -> None:
+        """Fence a dead process's whole chip range and cancel the attempts
+        holding any of it (they retry on the survivors)."""
+        health = self.device_pool.health
+        ages = [float(m.get("age_s", 0.0)) for m in members]
+        reason = (f"host {host_name} (process {pid}) missed heartbeats "
+                  f"for {min(ages) if ages else float('inf'):.1f}s")
+        chips = health.evict_host(pid, reason)
+        self._evicted_hosts.add(pid)
+        record_recovery("host.evict")
+        tracing.event("host_evict", host=pid, name=host_name, chips=chips)
+        if self.metrics:
+            self.m_pod_host_evictions.inc()
+        logger.error("host watchdog: EVICTED host %s (process %d) — "
+                     "chip(s) %s fenced", host_name, pid, chips)
+        if not chips:
+            return
+        lost = set(chips)
+        with self._records_lock:
+            live = list(self._live.items())
+        for msg_id, (token, attempt) in live:
+            if token.cancelled():
+                continue
+            lease = getattr(attempt.ctx, "device_token", None)
+            held = set(getattr(lease, "devices", ()) or ())
+            if held & lost:
+                rec = self._record(msg_id)
+                self._deliver_cancel(
+                    token, rec,
+                    f"host {host_name} evicted: lease chip(s) "
+                    f"{sorted(held & lost)} lost mid-attempt")
 
     # --------------------------------------------------------------- drain
     def _begin_drain(self) -> None:
@@ -1409,11 +1528,15 @@ class JobScheduler:
         next_beat = 0.0
         next_scan = 0.0
         next_gc = 0.0
+        next_host = 0.0
         gc_interval = (self.resources.cfg.gc_interval_s
                        if self.resources is not None else float("inf"))
+        hw_interval = (self.cfg.host_watchdog_interval_s
+                       if self.cfg.host_watchdog_interval_s > 0
+                       else float("inf"))
         tick = max(0.02, min(self.cfg.replica_heartbeat_interval_s,
                              self.cfg.takeover_interval_s,
-                             gc_interval) / 4.0)
+                             gc_interval, hw_interval) / 4.0)
         while not self._stop.is_set():
             now = time.time()
             # zero-loss drain (ISSUE 11): notice the request once, then ack
@@ -1445,6 +1568,15 @@ class JobScheduler:
                     logger.warning("replica %s: takeover scan failed",
                                    self.replica_id, exc_info=True)
                 next_scan = now + self.cfg.takeover_interval_s
+            if hw_interval != float("inf") and now >= next_host:
+                # pod host watchdog (ISSUE 17): missed process beats →
+                # whole-host eviction; a watchdog fault never kills the loop
+                try:
+                    self._host_watchdog(now)
+                except OSError:
+                    logger.warning("replica %s: host watchdog scan failed",
+                                   self.replica_id, exc_info=True)
+                next_host = now + hw_interval
             if self.resources is not None and now >= next_gc:
                 # bounded-retention GC (ISSUE 10): shard-scoped like the
                 # takeover sweeps above — a GC fault never kills the loop

@@ -205,9 +205,14 @@ class DeviceMonitor:
             self._prev_cache_entries = entries
         self._collect_warmup_events()
 
+        # pod identity (ISSUE 17): samples from different host processes
+        # interleave in shared dashboards — stamp which process took each
+        proc_id, proc_host = tracing.process()
         snap = {
             "ts": round(now, 3),
             **({"replica": self.replica_id} if self.replica_id else {}),
+            **({"process": proc_id} if proc_id >= 0 else {}),
+            **({"host": proc_host} if proc_host else {}),
             "devices": len(devices),
             "device_kind": devices[0]["kind"] if devices else None,
             "hbm_bytes_in_use": hbm_in_use,

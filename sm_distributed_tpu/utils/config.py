@@ -143,6 +143,14 @@ class ParallelConfig:
     coordinator_address: str = ""        # "" = single-process (no-op init)
     num_processes: int = 1
     process_id: int = -1                 # -1 = resolve from env/launcher
+    # coordinator launch race (ISSUE 17): every host process races the
+    # coordinator's bind at pod startup, so jax.distributed.initialize
+    # retries with exponential backoff (base * 2^attempt, capped at 30 s)
+    # before the failure is considered real
+    init_retries: int = 5                # attempts AFTER the first; 0 = one
+                                         # shot (fail fast)
+    init_backoff_s: float = 1.0          # first retry delay; doubles per
+                                         # attempt
     # mid-search resume (SURVEY §5.4): checkpoint scored metrics every N
     # formula batches; 0 disables.  A killed multi-hour search (BASELINE
     # configs #3/#5) resumes from the last complete group.
@@ -411,6 +419,15 @@ class ServiceConfig:
                                          # chips quarantined at which the
                                          # WHOLE host is evicted (>= 1.0
                                          # disables host eviction)
+    # --- pod host watchdog (service/scheduler.py, ISSUE 17) ---
+    host_watchdog_interval_s: float = 0.0  # cadence of the per-host process-
+                                         # heartbeat scan; 0 disables the
+                                         # watchdog (single-process pods)
+    host_stale_after_s: float = 10.0     # a host whose EVERY process beat is
+                                         # older than this is evicted: its
+                                         # chips quarantine as one unit and
+                                         # in-flight attempts on them cancel
+                                         # into the normal retry path
     # --- multi-replica scheduling (service/leases.py, ISSUE 8) ---
     replica_id: str = "r0"               # this scheduler process's identity
                                          # (serve --replica-id); leases and
@@ -463,6 +480,9 @@ class ServiceConfig:
                 "service: health_fault_quarantine must be >= 1, "
                 "health_reprobe_after_s >= 0, and "
                 "health_host_evict_fraction > 0 (>= 1.0 disables eviction)")
+        if self.host_watchdog_interval_s < 0 or self.host_stale_after_s <= 0:
+            raise ValueError("service: host_watchdog_interval_s must be >= 0 "
+                             "and host_stale_after_s positive")
         if not self.replica_id or self.replicas <= 0 or self.spool_shards <= 0:
             raise ValueError("service: replica_id must be non-empty and "
                              "replicas/spool_shards positive")

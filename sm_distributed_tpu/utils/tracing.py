@@ -193,6 +193,24 @@ def replica() -> str:
     return _replica_id
 
 
+# pod process identity (ISSUE 17): a cross-process mesh runs one scheduler
+# process per host — records carry (process_id, host) so a trace spanning a
+# host loss shows which process emitted each span
+_process_id = -1
+_host = ""
+
+
+def set_process(process_id: int, host: str = "") -> None:
+    """Set the pod identity stamped on every record (-1/"" disables)."""
+    global _process_id, _host
+    _process_id = int(process_id)
+    _host = str(host or "")
+
+
+def process() -> tuple[int, str]:
+    return _process_id, _host
+
+
 # --------------------------------------------------------------- file sink
 # cached append handles: one flushed line per record, no per-record open()
 _files_lock = threading.Lock()
@@ -319,6 +337,10 @@ def _base(ctx: TraceContext, name: str, kind: str) -> dict:
         rec["job_id"] = ctx.job_id
     if _replica_id:
         rec["replica"] = _replica_id
+    if _process_id >= 0:
+        rec["process"] = _process_id
+    if _host:
+        rec["host"] = _host
     return rec
 
 
@@ -370,6 +392,10 @@ def emit_span(ctx: TraceContext, name: str, /, ts: float = 0.0,
         rec["job_id"] = ctx.job_id
     if _replica_id:
         rec["replica"] = _replica_id
+    if _process_id >= 0:
+        rec["process"] = _process_id
+    if _host:
+        rec["host"] = _host
     if attrs:
         rec["attrs"] = attrs
     _emit(rec, ctx.file)

@@ -287,9 +287,13 @@ def test_pool_host_topology_and_single_host_preference():
     wide.release()
 
 
-def test_pool_non_dividing_hosts_degrades_to_single_host():
+def test_pool_non_dividing_hosts_splits_explicit_ranges():
+    # ISSUE 17 satellite: a ragged pool keeps its host count with explicit
+    # per-host ranges (warned) instead of silently degrading to one host
     p = DevicePool(8, hosts=3)
-    assert p.hosts == 1 and p.chips_per_host == 8
+    assert p.hosts == 3
+    assert p.host_ranges == ((0, 3), (3, 6), (6, 8))
+    assert [p.host_of(i) for i in range(8)] == [0, 0, 0, 1, 1, 1, 2, 2]
 
 
 def test_pool_reap_is_idempotent_and_counted():

@@ -1,0 +1,270 @@
+"""Pod-scale runtime tests (ISSUE 17): the managed ``parallel/distributed``
+runtime (retry ladder, shutdown/reset latch, process identity), the
+cross-process global device order, host-range attribution for ragged pools,
+whole-host eviction/return on the health tracker, and the bit-identity of
+FDR-ranked annotations between a plain single-process run and the same job
+under the simulated 2-process pod contract.
+
+The REAL 2-OS-process coordinator handshake is covered by the slow test in
+tests/test_distributed.py; everything here runs in-process at tier-1 speed
+via the ``SM_DIST_SIMULATE`` seam (the same one scripts/host_chaos.py's
+single-box "hosts" use)."""
+
+import logging
+import random
+import types
+
+import pytest
+
+from sm_distributed_tpu.utils.config import ParallelConfig
+from sm_distributed_tpu.utils.logger import LOGGER_NAME
+
+POD_ENV = {
+    "SM_DIST_SIMULATE": "1",
+    "SM_COORDINATOR": "127.0.0.1:12399",
+    "SM_NUM_PROCESSES": "2",
+    "SM_PROCESS_ID": "0",
+}
+
+
+def _pod_env(monkeypatch, **extra):
+    for k, v in {**POD_ENV, **extra}.items():
+        monkeypatch.setenv(k, v)
+
+
+# ---------------------------------------------------------------------------
+# managed runtime: retry ladder, shutdown/reset latch, identity (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_simulated_init_retries_then_shutdown_resets_latch(monkeypatch):
+    """The coordinator launch race: attempt 1 raises (injected), the backoff
+    ladder retries, the runtime comes up, and ``shutdown()`` clears the
+    idempotence latch so a second init starts clean."""
+    from sm_distributed_tpu.parallel import distributed
+    from sm_distributed_tpu.utils import failpoints
+
+    _pod_env(monkeypatch)
+    cfg = ParallelConfig(init_retries=5, init_backoff_s=0.0)
+    base = failpoints.recovery_counts().get("dist.init_retry", 0)
+    failpoints.configure("dist.initialize=raise:ConnectionError@1")
+    try:
+        assert distributed.maybe_initialize_distributed(cfg) is True
+        assert distributed.is_initialized()
+        # the retried-then-successful init reported itself
+        assert failpoints.recovery_counts()["dist.init_retry"] == base + 1
+        # idempotent while up: no second init attempt (the failpoint would
+        # not fire again anyway — @1 already consumed — but the latch
+        # short-circuits before the ladder entirely)
+        assert distributed.maybe_initialize_distributed(cfg) is True
+
+        distributed.shutdown()
+        assert not distributed.is_initialized()
+        # the latch really reset: a fresh init runs the ladder again
+        assert distributed.maybe_initialize_distributed(cfg) is True
+        assert distributed.is_initialized()
+    finally:
+        failpoints.configure(None)
+        distributed.shutdown()
+    assert not distributed.is_initialized()
+
+
+def test_init_retries_exhausted_raises_and_leaves_latch_clear(monkeypatch):
+    from sm_distributed_tpu.parallel import distributed
+    from sm_distributed_tpu.utils import failpoints
+
+    _pod_env(monkeypatch)
+    cfg = ParallelConfig(init_retries=2, init_backoff_s=0.0)
+    failpoints.configure("dist.initialize=raise:ConnectionError")  # every hit
+    try:
+        with pytest.raises(ConnectionError):
+            distributed.maybe_initialize_distributed(cfg)
+        assert not distributed.is_initialized()
+    finally:
+        failpoints.configure(None)
+        distributed.shutdown()
+
+
+def test_process_identity_env_contract(monkeypatch):
+    from sm_distributed_tpu.parallel.distributed import process_identity
+
+    monkeypatch.setenv("SM_PROCESS_ID", "3")
+    monkeypatch.setenv("SM_HOST_NAME", "hx")
+    assert process_identity() == {"process_id": 3, "host": "hx"}
+
+    # unparseable SM_PROCESS_ID degrades to 0, never raises
+    monkeypatch.setenv("SM_PROCESS_ID", "not-an-int")
+    assert process_identity()["process_id"] == 0
+
+    # no env, no runtime: process 0 on the real hostname
+    monkeypatch.delenv("SM_PROCESS_ID")
+    monkeypatch.delenv("SM_HOST_NAME")
+    import socket
+
+    ident = process_identity()
+    assert ident["process_id"] == 0
+    assert ident["host"] == socket.gethostname()
+
+
+# ---------------------------------------------------------------------------
+# cross-process global device order + host-range attribution (satellite 4)
+# ---------------------------------------------------------------------------
+
+def _fake_devices(n_proc=2, per_proc=4):
+    return [types.SimpleNamespace(process_index=p, id=i)
+            for p in range(n_proc) for i in range(per_proc)]
+
+
+def test_global_device_order_stable_under_permuted_enumeration():
+    """JAX documents no enumeration order across processes; the pool's chip
+    index -> Device map must not depend on it."""
+    from sm_distributed_tpu.parallel.mesh import global_device_order
+
+    devs = _fake_devices(n_proc=3, per_proc=4)
+    want = global_device_order(devs)
+    for seed in range(5):
+        shuffled = list(devs)
+        random.Random(seed).shuffle(shuffled)
+        assert global_device_order(shuffled) == want
+    # host-major: each process's chips form one contiguous index run,
+    # ids ascending within it
+    assert [d.process_index for d in want] == [0] * 4 + [1] * 4 + [2] * 4
+    for p in range(3):
+        assert [d.id for d in want[p * 4:(p + 1) * 4]] == [0, 1, 2, 3]
+
+
+def test_split_host_ranges_ragged_and_clamp(caplog):
+    from sm_distributed_tpu.service.health import (
+        host_of_ranges,
+        split_host_ranges,
+    )
+
+    with caplog.at_level(logging.WARNING, logger=LOGGER_NAME):
+        assert split_host_ranges(8, 2) == ((0, 4), (4, 8))
+    assert not caplog.records  # rectangular pods are silent
+
+    # ragged: the first `size % hosts` hosts absorb the extra chips — chip 6
+    # lands on host 1, not the nonexistent host 2 that 7 // (7 // 2) implied
+    with caplog.at_level(logging.WARNING, logger=LOGGER_NAME):
+        assert split_host_ranges(7, 2) == ((0, 4), (4, 7))
+    assert any("raggedly" in r.getMessage() for r in caplog.records)
+
+    # more hosts than chips clamps to single-chip domains
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger=LOGGER_NAME):
+        assert split_host_ranges(3, 5) == ((0, 1), (1, 2), (2, 3))
+    assert any("clamping" in r.getMessage() for r in caplog.records)
+
+    assert host_of_ranges(((0, 2), (2, 3))) == [0, 0, 1]
+    assert split_host_ranges(8, 3) == ((0, 3), (3, 6), (6, 8))
+
+
+def test_host_topology_with_explicit_ranges():
+    from sm_distributed_tpu.parallel.mesh import host_topology
+
+    ranges = ((0, 4), (4, 7))               # ragged 7-chip / 2-host pool
+    assert host_topology(range(7), ranges) == {0: (0, 1, 2, 3),
+                                               1: (4, 5, 6)}
+    assert host_topology([6], ranges) == {1: (6,)}      # the old int-division
+    assert host_topology([6], 3) == {2: (6,)}           # guess got this wrong
+
+
+def test_lease_spans_two_simulated_processes():
+    """An 8-chip lease on a 2-host pool spans both host failure domains
+    host-major; a half-pool lease is confined to one."""
+    from sm_distributed_tpu.parallel.mesh import host_topology
+    from sm_distributed_tpu.service.device_pool import DevicePool
+    from sm_distributed_tpu.service.health import HealthTracker
+
+    pool = DevicePool(8, hosts=2,
+                      health=HealthTracker(8, hosts=2, probe_on_lease=False))
+    assert pool.host_ranges == ((0, 4), (4, 8))
+
+    wide = pool.lease(8, msg_id="span")
+    assert wide.acquire(timeout=5.0)
+    try:
+        topo = host_topology(wide.devices, pool.host_ranges)
+        assert topo == {0: (0, 1, 2, 3), 1: (4, 5, 6, 7)}
+    finally:
+        wide.release()
+
+    narrow = pool.lease(4, msg_id="one-host")
+    assert narrow.acquire(timeout=5.0)
+    try:
+        assert len(host_topology(narrow.devices, pool.host_ranges)) == 1
+    finally:
+        narrow.release()
+
+
+def test_health_host_evict_and_return_roundtrip():
+    """Whole-host eviction fences every chip of the domain in one unit;
+    ``host_returned`` zeroes the re-probe cooldown so the half-open pass
+    readmits immediately instead of waiting out ``reprobe_after_s``."""
+    from sm_distributed_tpu.service.health import HealthTracker
+
+    h = HealthTracker(8, hosts=2, probe_on_lease=False,
+                      reprobe_after_s=60.0,
+                      probe_fn=lambda c: (True, "ok"))
+    evicted = h.evict_host(1, "host h1 (process 1) missed heartbeats")
+    assert evicted == [4, 5, 6, 7]
+    snap = h.snapshot()
+    assert snap["host_evictions_total"] == 1
+    assert [c["device"] for c in snap["chips"]
+            if c["state"] == "quarantined"] == [4, 5, 6, 7]
+    # idempotent; out-of-range host ids are refused, not crashed
+    assert h.evict_host(1, "again") == []
+    assert h.evict_host(7, "no such host") == []
+
+    # cooldown (60 s) has not elapsed: nothing due yet
+    assert h.reprobe_due() == []
+    # ...until the host's process heartbeats again
+    assert h.host_returned(1) == [4, 5, 6, 7]
+    assert sorted(h.reprobe_due()) == [4, 5, 6, 7]
+    assert h.snapshot()["quarantined"] == 0
+
+
+# ---------------------------------------------------------------------------
+# FDR-rank bit-identity: plain vs simulated 2-process pod (satellite 4)
+# ---------------------------------------------------------------------------
+
+def test_fdr_ranks_bit_identical_plain_vs_simulated_pod(
+        tmp_path, monkeypatch):
+    """The managed pod runtime must not perturb science: the same search on
+    the spheroid-like fixture produces bit-identical FDR-ranked annotations
+    whether it runs plain single-process or under the simulated 2-process
+    launch contract (env + init ladder + identity stamping engaged)."""
+    import pandas.testing as pdt
+
+    from sm_distributed_tpu.io.dataset import SpectralDataset
+    from sm_distributed_tpu.io.fixtures import generate_synthetic_dataset
+    from sm_distributed_tpu.models.msm_basic import MSMBasicSearch
+    from sm_distributed_tpu.parallel import distributed
+    from sm_distributed_tpu.utils.config import DSConfig, SMConfig
+
+    path, truth = generate_synthetic_dataset(
+        tmp_path / "ds", nrows=8, ncols=8, present_fraction=0.5,
+        noise_peaks=30, seed=17)
+    ds = SpectralDataset.from_imzml(path)
+    ds_config = DSConfig.from_dict(
+        {"isotope_generation": {"adducts": ["+H"]},
+         "image_generation": {"ppm": 3.0}})
+    formulas = list(truth.formulas)[:8]
+    sm = SMConfig.from_dict({
+        "backend": "jax_tpu",
+        "fdr": {"decoy_sample_size": 3, "seed": 5},
+        "parallel": {"formula_batch": 8, "pixels_axis": 2,
+                     "formulas_axis": 1},
+    })
+
+    plain = MSMBasicSearch(ds, formulas, ds_config, sm).search()
+    assert not distributed.is_initialized()
+
+    _pod_env(monkeypatch)
+    try:
+        pod = MSMBasicSearch(ds, formulas, ds_config, sm).search()
+        assert distributed.is_initialized()   # the search went through init
+    finally:
+        distributed.shutdown()
+
+    pdt.assert_frame_equal(pod.annotations, plain.annotations,
+                           check_exact=True)
+    assert list(pod.annotations["fdr"]) == list(plain.annotations["fdr"])
