@@ -56,7 +56,11 @@ _BENCH_RATE_KEYS = ("value", "patterns_per_s", "pixels_per_s",
                     # the scaling ratio itself are all higher-is-better
                     "single_chip_ions_per_s", "speedup_vs_single_chip",
                     # ISSUE 16: the read-plane mixed cold/warm query rate
-                    "reads_per_s")
+                    "reads_per_s",
+                    # ISSUE 18: measured fraction of the roofline ceiling —
+                    # falling further from the memory-bound floor is the
+                    # regression direction
+                    "roofline_frac")
 _BENCH_TIME_KEYS = ("compile_s", "isocalc_s", "isocalc_cold_s",
                     "single_chip_compile_s",
                     # ISSUE 13: cleared-cache cold-start pins — the
@@ -64,7 +68,11 @@ _BENCH_TIME_KEYS = ("compile_s", "isocalc_s", "isocalc_cold_s",
                     # warm headline
                     "cold_compile_s", "first_annotation_cold_s",
                     # ISSUE 16: read-plane median query latency
-                    "read_p50_ms")
+                    "read_p50_ms",
+                    # ISSUE 18: compacted resident-cube HBM footprint —
+                    # quietly growing back toward the f32 baseline is the
+                    # regression direction (bytes, well past --min-seconds)
+                    "resident_cube_bytes")
 # nested bench cases ride along ("multichip" appears on --devices N runs)
 _CASE_KEYS = ("scale", "desi", "multichip")
 
@@ -143,6 +151,13 @@ def normalize(data: dict) -> dict[str, tuple[float, str]]:
                 out[f"numerics.max_ulp.{comp}"] = (v, "down")
         if (v := _num(data.get("fdr_rank_mismatches"))) is not None:
             out["numerics.fdr_rank_mismatches"] = (v, "down")
+        # ISSUE 18: the fused-kernel + bf16-cube path rides the same
+        # drift series — rising data-level drift regresses
+        for comp, v in (data.get("sm_numerics_max_ulp_fused") or {}).items():
+            if (v := _num(v)) is not None:
+                out[f"numerics.max_ulp_fused.{comp}"] = (v, "down")
+        if (v := _num(data.get("fdr_rank_mismatches_fused"))) is not None:
+            out["numerics.fdr_rank_mismatches_fused"] = (v, "down")
     return out
 
 

@@ -92,6 +92,18 @@ def main() -> None:
     ap.add_argument("--tiny", action="store_true",
                     help="CI smoke shape (16x16 px, 8 formulas, tiny "
                          "microbenches)")
+    ap.add_argument("--fused", choices=("auto", "on", "off"), default="auto",
+                    help="parallel.fused_metrics for the probed backend "
+                         "(ISSUE 18; 'on' forces the Pallas kernel, "
+                         "interpret-mode off-TPU)")
+    ap.add_argument("--cube-dtype", choices=("f32", "bf16", "int8"),
+                    default="f32",
+                    help="parallel.cube_dtype for the probed backend")
+    ap.add_argument("--min-frac", type=float, default=0.0,
+                    help="exit nonzero unless roofline_frac (= floor_s / "
+                         "measured_s) >= this — the check_tier1 gate that "
+                         "keeps measured-vs-model from regressing "
+                         "catastrophically on whatever hardware runs CI")
     args = ap.parse_args()
     if args.tiny:
         args.nrows = args.ncols = 16
@@ -118,6 +130,8 @@ def main() -> None:
         {"backend": "jax_tpu",
          "fdr": {"decoy_sample_size": args.decoy_sample_size},
          "parallel": {"formula_batch": args.formula_batch,
+                      "fused_metrics": args.fused,
+                      "cube_dtype": args.cube_dtype,
                       "compile_cache_dir": str(cache_dir / "xla_cache")}})
     backend = make_backend("jax_tpu", ds, prep["ds_config"], sm_config,
                            table=table)
@@ -141,6 +155,12 @@ def main() -> None:
     resident = getattr(backend, "_mz_host", None)
     resident_peaks = int(resident.size) if resident is not None else int(
         ds.n_peaks)
+    # price the variant that actually dispatched: 'on' forces the fused
+    # kernel everywhere; 'auto' engages it only on a real TPU
+    import jax
+
+    fused_active = args.fused == "on" or (
+        args.fused == "auto" and jax.default_backend() == "tpu")
     model = fused_score_cost_model(
         n_pixels=ds.n_pixels,
         resident_peaks=resident_peaks,
@@ -149,10 +169,14 @@ def main() -> None:
         formula_batch=args.formula_batch,
         nlevels=prep["ds_config"].image_generation.nlevels,
         ordered=True,
+        fused=fused_active,
+        cube_dtype=args.cube_dtype,
     )
     t_bw = model["total_bytes"] / (peaks["peak_bw_gbps"] * 1e9)
     t_fl = model["matmul_flops"] / (peaks["peak_matmul_gflops"] * 1e9)
     floor_s = max(t_bw, t_fl)
+    frac = floor_s / measured_s if measured_s > 0 else 0.0
+    int_bytes = {"f32": 4, "bf16": 2, "int8": 1}[args.cube_dtype]
     out = {
         "metric": "fused_score_roofline",
         "measured_s_per_rep": round(measured_s, 4),
@@ -164,11 +188,22 @@ def main() -> None:
         "roofline_floor_s": round(floor_s, 4),
         "bound": "bandwidth" if t_bw >= t_fl else "compute",
         "headroom_x": round(measured_s / floor_s, 2) if floor_s > 0 else None,
+        "roofline_frac": round(frac, 4),
+        "fused": bool(fused_active),
+        "cube_dtype": args.cube_dtype,
+        "resident_cube_bytes": int(resident_peaks * int_bytes),
         "n_ions": int(table.n_ions),
         "n_pixels": int(ds.n_pixels),
         "resident_peaks": resident_peaks,
     }
     print(json.dumps(out))
+    if args.min_frac and frac < args.min_frac:
+        logger.error(
+            "roofline_frac %.4f below gate --min-frac %.4f "
+            "(measured %.4fs vs model floor %.4fs)",
+            frac, args.min_frac, measured_s, floor_s)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

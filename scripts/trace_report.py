@@ -126,6 +126,16 @@ def summarize(records: list[dict]) -> dict:
                 float((r.get("attrs") or {}).get("dur_s", 0.0))
                 for r in _events(records, "compile")
                 if (r.get("attrs") or {}).get("cached")), 6),
+            # warm-start attribution (ISSUE 18): jaxpr tracing and MLIR
+            # lowering run on every compile-cache miss even when the
+            # executable then loads off the persistent cache — the part
+            # of a "warm" start the cache cannot remove
+            "compile_trace_s": round(sum(
+                float((r.get("attrs") or {}).get("dur_s", 0.0))
+                for r in _events(records, "compile_trace")), 6),
+            "compile_lower_s": round(sum(
+                float((r.get("attrs") or {}).get("dur_s", 0.0))
+                for r in _events(records, "compile_lower")), 6),
             "isocalc_gen_s": round(sum(
                 float(r["dur"]) for r in _spans(records, "isocalc_gen")), 6),
             # submit → first FDR-rankable annotations (the streamed
@@ -196,6 +206,12 @@ def render(s: dict) -> str:
                  f"{_pct(a['compile_s'], total)}")
     lines.append(f"  xla cache loads        {a['compile_cache_load_s']:9.3f}s "
                  f"{_pct(a['compile_cache_load_s'], total)}")
+    lines.append(f"  jaxpr trace            "
+                 f"{a.get('compile_trace_s', 0.0):9.3f}s "
+                 f"{_pct(a.get('compile_trace_s', 0.0), total)}")
+    lines.append(f"  mlir lower             "
+                 f"{a.get('compile_lower_s', 0.0):9.3f}s "
+                 f"{_pct(a.get('compile_lower_s', 0.0), total)}")
     if a.get("first_annotation_s") is not None:
         lines.append(f"  first annotation at    "
                      f"{a['first_annotation_s']:9.3f}s "

@@ -115,20 +115,40 @@ def measure(workdir: str | Path | None = None) -> dict:
     want = score_all(NumpyBackend(ds, dc))   # the fp32/numpy oracle
     drift = numerics.component_drift(got, want)
 
+    # fused+compacted path (ISSUE 18): the fused Pallas scoring kernel
+    # (interpret-mode off-TPU) over the bf16-compacted resident cube.
+    # Its drift vs the plain-f32 jax path is DATA-level (the cube lost
+    # mantissa bits), so it gates against ops/quantize.py's declared
+    # compact_cube contract — not the same-data COMPONENT_CONTRACTS —
+    # plus the same HARD FDR-rank-identity bar vs the numpy oracle.
+    from sm_distributed_tpu.ops.quantize import NUMERICS as _QN
+
+    cube_ulps = numerics.contract_ulps(
+        numerics.parse_policy(_QN["compact_cube"])["contract"])
+    sm_fused = SMConfig.from_dict({
+        "backend": "jax_tpu",
+        "parallel": {"formula_batch": fx["formula_batch"],
+                     "fused_metrics": "on", "cube_dtype": "bf16"}})
+    got_fused = score_all(JaxBackend(ds, dc, sm_fused))
+    drift_fused = numerics.component_drift(got_fused, got)
+
     def ranks(metrics: np.ndarray):
         df = pd.DataFrame({"sf": table.sfs, "adduct": table.adducts,
                            "msm": metrics[:, 3]})
         ann = fdr.estimate_fdr(df, assignment)
         return ann.sort_values(["msm", "sf"], ascending=False)
 
-    r_jax, r_np = ranks(got), ranks(want)
-    order_mismatches = int(sum(
-        a != b for a, b in zip(r_jax.sf.tolist(), r_np.sf.tolist())))
-    levels_equal = bool(
-        (r_jax.fdr.to_numpy() == r_np.fdr.to_numpy()).all() and
-        (r_jax.fdr_level.to_numpy() == r_np.fdr_level.to_numpy()).all())
-    mismatches = order_mismatches if order_mismatches else (
-        0 if levels_equal else 1)
+    def rank_mismatches(r_got, r_ref) -> int:
+        order = int(sum(
+            a != b for a, b in zip(r_got.sf.tolist(), r_ref.sf.tolist())))
+        levels_equal = bool(
+            (r_got.fdr.to_numpy() == r_ref.fdr.to_numpy()).all() and
+            (r_got.fdr_level.to_numpy() == r_ref.fdr_level.to_numpy()).all())
+        return order if order else (0 if levels_equal else 1)
+
+    r_np = ranks(want)
+    mismatches = rank_mismatches(ranks(got), r_np)
+    mismatches_fused = rank_mismatches(ranks(got_fused), r_np)
 
     reg = numerics.registered()
     return {
@@ -141,6 +161,15 @@ def measure(workdir: str | Path | None = None) -> dict:
         "sm_numerics_max_ulp": drift,
         "fdr_rank_mismatches": mismatches,
         "fdr_ranks_identical": mismatches == 0,
+        # fused Pallas kernel + bf16 cube (ISSUE 18): drift vs plain-f32
+        # jax, gated by the compact_cube data-level contract; rank
+        # identity vs the numpy oracle stays the HARD bar
+        "fused_metrics": "on",
+        "cube_dtype": "bf16",
+        "cube_contract_ulps": int(cube_ulps),
+        "sm_numerics_max_ulp_fused": drift_fused,
+        "fdr_rank_mismatches_fused": mismatches_fused,
+        "fdr_ranks_identical_fused": mismatches_fused == 0,
         "component_contracts": dict(numerics.COMPONENT_CONTRACTS),
         "declared_contracts": sum(len(e) for e in reg.values()),
         "declared_modules": len(reg),
@@ -162,6 +191,13 @@ def gate(artifact: dict, history_paths: list[str], tolerance: float,
               f"mismatch(es)); rank identity is the HARD contract",
               file=sys.stderr)
         rc = 1
+    if artifact.get("fdr_rank_mismatches_fused", 0) != 0 or \
+            not artifact.get("fdr_ranks_identical_fused", True):
+        print(f"ulp_sentinel: {label}: FAIL — fused+compacted-vs-numpy "
+              f"FDR ranks diverge "
+              f"({artifact.get('fdr_rank_mismatches_fused')} mismatch(es)); "
+              f"rank identity is the HARD contract", file=sys.stderr)
+        rc = 1
     ceilings = {**numerics.COMPONENT_CONTRACTS,
                 **artifact.get("component_contracts", {})}
     for comp, ulps in (artifact.get("sm_numerics_max_ulp") or {}).items():
@@ -170,6 +206,16 @@ def gate(artifact: dict, history_paths: list[str], tolerance: float,
             print(f"ulp_sentinel: {label}: FAIL — {comp} drift {ulps} "
                   f"ULPs exceeds its declared contract of {ceiling}",
                   file=sys.stderr)
+            rc = 1
+    # fused+bf16 drift is data-level — its ceiling is the compact_cube
+    # contract the artifact itself carries (ops/quantize.py NUMERICS)
+    cube_ceiling = artifact.get("cube_contract_ulps")
+    for comp, ulps in (artifact.get("sm_numerics_max_ulp_fused")
+                       or {}).items():
+        if cube_ceiling is not None and ulps > cube_ceiling:
+            print(f"ulp_sentinel: {label}: FAIL — fused+compacted {comp} "
+                  f"drift {ulps} ULPs exceeds the compact_cube contract "
+                  f"of {cube_ceiling}", file=sys.stderr)
             rc = 1
     band_rc = perf_sentinel.run_check(
         history_paths, perf_sentinel.normalize(artifact), tolerance,
@@ -191,8 +237,14 @@ def degrade(artifact: dict) -> dict:
     ceilings = bad.get("component_contracts") or {}
     for comp in ulp:
         ulp[comp] = 2 * int(ceilings.get(comp, 0)) + 8
+    ulp_fused = bad.get("sm_numerics_max_ulp_fused") or {}
+    for comp in ulp_fused:
+        ulp_fused[comp] = 2 * int(bad.get("cube_contract_ulps", 0)) + 8
     bad["fdr_rank_mismatches"] = 1
     bad["fdr_ranks_identical"] = False
+    if "fdr_ranks_identical_fused" in bad:
+        bad["fdr_rank_mismatches_fused"] = 1
+        bad["fdr_ranks_identical_fused"] = False
     return bad
 
 

@@ -47,6 +47,21 @@ from ..utils.logger import logger
 # a primed cache must show up as loads, not compiles).
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+# warm-start attribution (ISSUE 18): the other places a "warm" compile_s
+# actually goes.  jaxpr tracing and jaxpr->MLIR lowering run on EVERY
+# compile-cache miss (even when the executable then loads off the
+# persistent cache — the cache key needs the lowered module), and the
+# cache-retrieval event times the disk read + deserialize alone.  The
+# census accumulates all four buckets so bench.py / trace_report.py can
+# split warm compile seconds into trace / lower / cache-load / backend-
+# compile instead of one opaque number.
+TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+LOWER_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+CACHE_LOAD_EVENT = "/jax/compilation_cache/cache_retrieval_time_sec"
+# census duration buckets, keyed by the reported field name
+_DURATION_KEYS = ("trace_s", "lower_s", "cache_load_s", "backend_compile_s")
+_EVENT_BUCKET = {TRACE_EVENT: "trace_s", LOWER_EVENT: "lower_s",
+                 CACHE_LOAD_EVENT: "cache_load_s"}
 
 _REPO_ROOT = Path(__file__).resolve().parents[2]
 _SELF = Path(__file__).resolve()
@@ -61,7 +76,8 @@ class _Census:
     """Process-global compile census (smlint guarded-by)."""
 
     _GUARDED_BY = {"_sites": "_lock", "_events_total": "_lock",
-                   "_overflow": "_lock", "_cache_hits_total": "_lock"}
+                   "_overflow": "_lock", "_cache_hits_total": "_lock",
+                   "_durations": "_lock"}
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -69,6 +85,7 @@ class _Census:
         self._events_total = 0
         self._cache_hits_total = 0          # persistent-cache loads (primed)
         self._overflow = 0                  # signatures dropped past the cap
+        self._durations = dict.fromkeys(_DURATION_KEYS, 0.0)
 
     def _entry_locked(self, site: str) -> dict:
         return self._sites.setdefault(
@@ -96,11 +113,19 @@ class _Census:
             self._entry_locked(site)["cache_hits"] += 1
             self._cache_hits_total += 1
 
+    def record_duration(self, bucket: str, seconds: float) -> None:
+        """Accumulate one compile-pipeline stage duration (ISSUE 18
+        warm-start attribution)."""
+        with self._lock:
+            self._durations[bucket] += seconds
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "events_total": self._events_total,
                 "cache_hits_total": self._cache_hits_total,
+                "durations": {k: round(v, 6)
+                              for k, v in self._durations.items()},
                 "signatures_total": sum(
                     len(e["signatures"]) for e in self._sites.values()),
                 "overflow": self._overflow,
@@ -118,6 +143,7 @@ class _Census:
             self._events_total = 0
             self._cache_hits_total = 0
             self._overflow = 0
+            self._durations = dict.fromkeys(_DURATION_KEYS, 0.0)
 
 
 _census = _Census()
@@ -179,11 +205,33 @@ def _on_event(name: str, **_kw) -> None:
 
 def _on_event_duration(name: str, duration: float, **_kw) -> None:
     global _warned
-    if name != COMPILE_EVENT or not _active:
+    if not _active:
+        return
+    if name in _EVENT_BUCKET:
+        # compile-pipeline stage durations (warm-start attribution): one
+        # firing per compile-cache miss / cache read — census totals plus
+        # a trace event so a job trace shows where its warm seconds went
+        try:
+            bucket = _EVENT_BUCKET[name]
+            _census.record_duration(bucket, float(duration))
+            tracing.event(f"compile_{bucket.removesuffix('_s')}",
+                          dur_s=round(float(duration), 4))
+        except Exception:
+            if not _warned:
+                _warned = True
+                logger.warning("retrace tracer: attribution failed (disabled "
+                               "for this event only)", exc_info=True)
+        return
+    if name != COMPILE_EVENT:
         return
     try:
         cached = bool(getattr(_tls, "cache_hit", False))
         _tls.cache_hit = False
+        if not cached:
+            # a cached firing's duration is the retrieval (already in the
+            # cache_load_s bucket via CACHE_LOAD_EVENT) — only a real
+            # backend compile lands here
+            _census.record_duration("backend_compile_s", float(duration))
         site, fn_name, sig = _attribute()
         signature = f"{fn_name}{sig}" if fn_name else sig
         m = _metrics
@@ -260,8 +308,9 @@ def enabled() -> bool:
 
 
 def snapshot() -> dict:
-    """Census contents: ``{events_total, signatures_total, overflow,
-    sites: {site: {events, signatures}}}``."""
+    """Census contents: ``{events_total, cache_hits_total, durations:
+    {trace_s, lower_s, cache_load_s, backend_compile_s}, signatures_total,
+    overflow, sites: {site: {events, cache_hits, signatures}}}``."""
     return _census.snapshot()
 
 

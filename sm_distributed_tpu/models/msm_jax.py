@@ -45,11 +45,13 @@ from ..ops.imager_jax import (
 from ..ops.isocalc import IsotopePatternTable
 from ..ops.metrics_jax import (
     batch_metrics,
+    batch_metrics_from_partials,
     correlation_from_moments,
     isotope_pattern_match_batch,
     measure_of_chaos_batch,
 )
-from ..ops.quantize import quantize_window
+from ..ops.quantize import compact_cube, expand_cube_jnp, quantize_window
+from ..ops.score_pallas import cols_padded, fused_fit, fused_window_moments
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
 
@@ -76,6 +78,16 @@ COMPILE_SURFACE = compile_surface(__name__, {
         "statics=gc_width,b,k,w_cap; buckets=flat-banded statics + w_cap on "
         "the {1,1.125..1.875}x pow-2 band_bucket ladder "
         "(ops/imager_jax.band_bucket)",
+    "fused_score_fn_flat_fused":
+        "statics=gc_width,b,k; buckets=flat-banded statics (ISSUE 18): the "
+        "fused Pallas kernel's grid/tiling derive from the same lattice "
+        "shapes, starts/n_real ride as traced (scalar-prefetch) operands, "
+        "and the cube dtype is a per-backend constant — so the fused "
+        "family is exactly the plain family's size",
+    "expand_cube_jnp":
+        "statics=none; buckets=probe-only — one f32 expansion of the "
+        "compact resident cube per probed backend (production expands "
+        "inside the scoring jits)",
     "extract_images":
         "statics=none; buckets=one executable per backend — cube-path image "
         "export at the padded (b, k) batch shape",
@@ -120,6 +132,12 @@ NUMERICS = numerics_surface(__name__, {
     "fused_score_fn_flat_banded_sliced":
         "contract=bit_exact; test=tests/test_jax_backend.py::"
         "test_band_slice_bit_exact; padded=pixel_sorted,int_sorted",
+    "fused_score_fn_flat_fused":
+        "contract=ulp(16); test=tests/test_score_pallas.py::"
+        "test_fused_variant_matches_plain; padded=pixel_sorted,int_sorted",
+    "expand_cube_jnp":
+        "contract=bit_exact; test=tests/test_score_pallas.py::"
+        "test_compact_expand_roundtrip",
     "extract_images":
         "contract=bit_exact; test=tests/test_jax_backend.py::"
         "test_extraction_parity",
@@ -171,6 +189,7 @@ def fused_score_fn_flat_banded(
     theor_ints: jnp.ndarray,
     n_valid: jnp.ndarray,
     n_real=None,               # () i32 traced: REAL pixel count (lattice)
+    scales=None,               # (N/QTILE,) f32 int8-cube dequant factors
     *,
     gc_width: int,
     b: int,
@@ -195,7 +214,14 @@ def fused_score_fn_flat_banded(
     lattice capacity, so every dataset size in a bucket shares ONE
     executable; ``n_real`` carries the true pixel count as a traced
     scalar for the masked metric centering (bit-identical to unpadded —
-    see batch_metrics)."""
+    see batch_metrics).
+
+    ``scales`` + a compact ``int_sorted`` dtype (parallel.cube_dtype,
+    ISSUE 18): the resident cube arrives bf16/int8 and is expanded to an
+    f32 TRANSIENT in-graph (XLA fuses the cast into the scatter's operand
+    read) — with cube_dtype="f32" (legacy default) the expansion is a
+    python-level no-op and the traced program is byte-identical."""
+    int_sorted = expand_cube_jnp(int_sorted, scales)
     imgs = extract_images_flat_banded(
         pixel_sorted, int_sorted, pos, starts, r_lo_loc, r_hi_loc, None,
         gc_width=gc_width, n_pixels=nrows * ncols)
@@ -205,6 +231,76 @@ def fused_score_fn_flat_banded(
         imgs, theor_ints, n_valid, nrows, ncols, nlevels,
         do_preprocessing=do_preprocessing, q=q, n_real=n_real,
     )
+    return jnp.take(out, inv, axis=0)
+
+
+def fused_score_fn_flat_fused(
+    pixel_sorted: jnp.ndarray,  # (N,) int32
+    int_sorted: jnp.ndarray,   # (N,) f32/bf16/int8 resident intensities
+    pos: jnp.ndarray,          # (G,) int32 host-computed bound ranks
+    starts: jnp.ndarray,       # (C,) chunk grid offsets
+    r_lo_loc: jnp.ndarray,     # (C, Wc)
+    r_hi_loc: jnp.ndarray,     # (C, Wc)
+    inv: jnp.ndarray,          # (B*K,)
+    theor_ints: jnp.ndarray,
+    n_valid: jnp.ndarray,
+    n_real=None,               # () i32 traced: REAL pixel count (lattice)
+    scales=None,               # (N/QTILE,) f32 int8-cube dequant factors
+    *,
+    gc_width: int,
+    b: int,
+    k: int,
+    nrows: int,
+    ncols: int,
+    nlevels: int,
+    do_preprocessing: bool,
+    q: float,
+) -> jnp.ndarray:
+    """Flat-path scoring through the ONE-PASS fused Pallas kernel
+    (ops/score_pallas.py, ISSUE 18): the banded membership matmul and
+    every per-window moment reduction happen on VMEM-staged tiles of the
+    histogram — the (b, k, P) image block never round-trips HBM; only the
+    principal rows (chaos needs their spatial layout) are written back.
+
+    Same argument layout and statics as ``fused_score_fn_flat_banded``
+    (the 'plain' variant) — the routing in ``JaxBackend._flat_call`` just
+    swaps the jit.  Metric rows come back in the plan's chunk-sorted ion
+    order and ``inv`` un-permutes them, exactly like the other variants.
+
+    Numerics: principal images / chaos / spectral / vmax / nn are
+    bit-exact vs the plain variant (exact integer-grid sums in any
+    association order); the spatial correlation's centered reductions
+    re-associate per pixel tile — within the declared ulp(16) ceiling.
+    The fused route requires ``do_preprocessing=False`` (hotspot clipping
+    needs the full materialized image block); routing enforces it."""
+    if do_preprocessing:
+        raise ValueError(
+            "the fused scoring kernel cannot apply hotspot preprocessing "
+            "(no materialized image block); route via the plain variant")
+    int_sorted = expand_cube_jnp(int_sorted, scales)
+    n_pix = nrows * ncols
+    n = pixel_sorted.shape[0]
+    g = pos.shape[0]
+    # the same bins-major histogram as extract_images_flat_banded, with
+    # the scratch rows padded to whole super-rows (score_pallas.SC) plus
+    # the spare band the unclamped super-row fetch may touch — spare rows
+    # are zero-initialized and outside every window's rank range
+    delta = jnp.zeros(n + 1, jnp.int32).at[pos].add(1)
+    bins = jnp.cumsum(delta[:-1])
+    cols_p = cols_padded(g, gc_width)
+    wh = jnp.zeros((cols_p, n_pix + 1), jnp.float32).at[
+        bins, pixel_sorted].add(int_sorted)
+    whp = wh[:, :n_pix]
+    nr = n_real if n_real is not None else np.int32(n_pix)
+    # CPU (tests, sentinel, fused_metrics="on" off-TPU) runs the Pallas
+    # interpreter — same kernel schedule, no Mosaic tiling constraints
+    interpret = jax.default_backend() != "tpu"
+    partials, principal = fused_window_moments(
+        whp, starts, r_lo_loc, r_hi_loc, nr,
+        gc_width=gc_width, k=k, interpret=interpret)
+    out = batch_metrics_from_partials(
+        partials.reshape(b, k, 5), principal.reshape(b, n_pix),
+        theor_ints, n_valid, nrows, ncols, nlevels)
     return jnp.take(out, inv, axis=0)
 
 
@@ -235,6 +331,7 @@ def fused_score_fn_flat_banded_sliced(
     theor_ints: jnp.ndarray,
     n_valid: jnp.ndarray,
     n_real=None,               # () i32 traced: REAL pixel count (lattice)
+    scales=None,               # (N/QTILE,) f32 int8-cube dequant factors
     *,
     w_cap: int,
     gc_width: int,
@@ -259,6 +356,7 @@ def fused_score_fn_flat_banded_sliced(
     metrics) are bit-identical to the uncompacted path.  Ion-major chunk
     plan: see fused_score_fn_flat_banded (``inv`` un-permutes metric
     rows)."""
+    int_sorted = expand_cube_jnp(int_sorted, scales)
     px_b = jax.lax.dynamic_slice(pixel_sorted, (w_start,), (w_cap,))
     in_b = jax.lax.dynamic_slice(int_sorted, (w_start,), (w_cap,))
     imgs = extract_images_flat_banded(
@@ -302,6 +400,7 @@ def fused_score_fn_flat_banded_compact(
     theor_ints: jnp.ndarray,
     n_valid: jnp.ndarray,
     n_real=None,               # () i32 traced: REAL pixel count (lattice)
+    scales=None,               # (N/QTILE,) f32 int8-cube dequant factors
     *,
     n_keep: int,
     gc_width: int,
@@ -320,6 +419,7 @@ def fused_score_fn_flat_banded_compact(
     Images, and hence metrics, are bit-identical to the uncompacted path.
     Ion-major chunk plan: see fused_score_fn_flat_banded (``inv``
     un-permutes metric rows)."""
+    int_sorted = expand_cube_jnp(int_sorted, scales)
     px_b, in_b = compact_peaks(
         pixel_sorted, int_sorted, run_pos, run_delta, n_b,
         n_keep=n_keep, n_pixels=nrows * ncols)
@@ -379,6 +479,11 @@ _VARIANTS = {
     "plain": ("_fn", extract_images_flat_banded, 5, 0),
     "compact": ("_fn_c", _extract_compact, 8, 3),
     "band": ("_fn_bs", _extract_sliced, 6, 1),
+    # the fused Pallas scorer (ISSUE 18) shares the plain variant's
+    # argument layout and statics — only the jit differs; its extraction
+    # probe is the plain banded extraction (the fused kernel has no
+    # standalone image phase — that is the point)
+    "fused": ("_fn_f", extract_images_flat_banded, 5, 0),
 }
 
 
@@ -403,6 +508,9 @@ def make_flat_jits(common: dict) -> dict:
         "band": jax.jit(
             partial(fused_score_fn_flat_banded_sliced, **common),
             static_argnames=("w_cap", "gc_width", "b", "k")),
+        "fused": jax.jit(
+            partial(fused_score_fn_flat_fused, **common),
+            static_argnames=("gc_width", "b", "k")),
     }
 
 
@@ -625,18 +733,44 @@ class JaxBackend:
                         [px_s, np.full(tail, ds.n_pixels, px_s.dtype)])
                     in_s = np.concatenate(
                         [in_s, np.zeros(tail, in_s.dtype)])
+            # resident-cube intensity compaction (ISSUE 18): bf16 halves /
+            # int8 quarters the HBM-resident cube; the f32 view is a
+            # per-batch transient inside the scoring jits.  int8 needs
+            # QTILE-aligned peaks — lattice points are 1024-multiples, so
+            # only the lattice-off int8 combination pads here (same
+            # zero-intensity overflow-row slots as the lattice pad).
+            self._cube_dtype = sm_config.parallel.cube_dtype
+            from ..ops.quantize import MZ_PAD_Q, QTILE
+            if self._cube_dtype == "int8" and in_s.size % QTILE != 0:
+                tail = -in_s.size % QTILE
+                mz_s = np.concatenate(
+                    [mz_s, np.full(tail, MZ_PAD_Q, mz_s.dtype)])
+                px_s = np.concatenate(
+                    [px_s, np.full(tail, ds.n_pixels, px_s.dtype)])
+                in_s = np.concatenate([in_s, np.zeros(tail, in_s.dtype)])
+            codes, scales = compact_cube(in_s, self._cube_dtype)
             self._mz_host = mz_s
             self._px_s = jax.device_put(px_s, self.device)
-            self._in_s = jax.device_put(in_s, self.device)
+            self._in_s = jax.device_put(codes, self.device)
+            self._scales = (jax.device_put(scales, self.device)
+                            if scales is not None else None)
             logger.info(
-                "jax_tpu flat peaks resident: %d sorted peaks (%.1f MB) on %s",
-                mz_s.size, (px_s.nbytes + in_s.nbytes) / 1e6,
-                self._px_s.devices(),
+                "jax_tpu flat peaks resident: %d sorted peaks (%.1f MB, "
+                "cube_dtype=%s) on %s",
+                mz_s.size,
+                (px_s.nbytes + codes.nbytes) / 1e6,
+                self._cube_dtype, self._px_s.devices(),
             )
             fns = make_flat_jits(common)
             self._fn = fns["plain"]
             self._fn_c = fns["compact"]
             self._fn_bs = fns["band"]
+            self._fn_f = fns["fused"]
+            # fused-kernel routing (ISSUE 18): "auto" fuses on TPU when
+            # the plan shape fits the kernel's VMEM budget; "on" forces
+            # the fused variant everywhere (interpret-mode off-TPU — the
+            # tests/sentinel path); hotspot preprocessing excludes fusion
+            self._fused_mode = sm_config.parallel.fused_metrics
             # sticky static shapes: grow to the max seen so one executable
             # serves (almost) all batches instead of recompiling per batch
             self._gc_width = 0
@@ -765,6 +899,36 @@ class JaxBackend:
                 est["band"] = 14.0 * cap
         return min(est, key=est.get)
 
+    def _maybe_fuse(self, variant: str, wc: int, gc_eff: int, k: int) -> str:
+        """Fused-kernel routing (ISSUE 18).  'on' forces the fused variant
+        from ANY cost-model choice (tests/sentinel: interpret-mode off-TPU);
+        'auto' upgrades only the plain variant — band/compact reshape the
+        resident cube before scatter, which the fused kernel's unblocked
+        band staging does not model — and only on a real TPU where the
+        (wc, cols_p, pt) plan fits the kernel's VMEM budget (fused_fit).
+        Hotspot preprocessing needs materialized images, so it excludes
+        fusion entirely."""
+        if self._fused_mode == "off" or self._common["do_preprocessing"]:
+            return variant
+        if self._fused_mode == "on":
+            return "fused"
+        if (variant == "plain" and jax.default_backend() == "tpu"
+                and fused_fit(wc, wc // max(k, 1), self._n_pix_b, gc_eff)):
+            return "fused"
+        return variant
+
+    def _in_f32(self):
+        """f32 view of the (possibly compacted) resident intensity cube for
+        the probe/export paths that bypass the scoring jits.  Materialized
+        once, lazily — probe-only (COMPILE_SURFACE: expand_cube_jnp); the
+        production jits expand in-graph instead."""
+        if self._cube_dtype == "f32":
+            return self._in_s
+        if not hasattr(self, "_in_f32_cache"):
+            self._in_f32_cache = jax.jit(expand_cube_jnp)(
+                self._in_s, self._scales)
+        return self._in_f32_cache
+
     def _grow_compact_capacity(self, runs) -> None:
         # clamp at the resident peak count: padded slots still gather and
         # scatter, so a 64k rounding floor on a tiny dataset would cost
@@ -798,7 +962,8 @@ class JaxBackend:
         else:
             self._gc_tail = max(self._gc_tail, gc_width)
             gc_eff = self._gc_tail
-        variant = self._variant_for(runs, band)
+        variant = self._maybe_fuse(
+            self._variant_for(runs, band), r_lo_loc.shape[1], gc_eff, k)
         # explicit async device_put: the transfers overlap device compute
         # of previously enqueued batches instead of blocking dispatch
         if variant == "band":
@@ -834,6 +999,14 @@ class JaxBackend:
         if self._n_real is not None:
             # the lattice's traced real-pixel scalar rides after n_valid
             args.append(jax.device_put(self._n_real))
+        if self._scales is not None:
+            # int8 cube: the per-tile dequant scales ride last; off-lattice
+            # they still need the n_real slot filled (None traces as an
+            # empty pytree) so positions match the fn signatures
+            if self._n_real is None:
+                args.append(None)
+            args.append(self._scales)
+        if self._n_real is not None:
             shape_buckets.record_spec(
                 self._bucket_spec(variant, args, statics))
         return variant, args, statics
@@ -846,7 +1019,7 @@ class JaxBackend:
         never drift from what dispatched)."""
         pos_ix = _VARIANTS[variant][3]
         rlo = args[pos_ix + 2]
-        return {
+        spec = {
             "kind": "flat", "variant": variant,
             "nrows": int(self._common["nrows"]),
             "ncols": int(self._common["ncols"]),
@@ -863,6 +1036,11 @@ class JaxBackend:
             "c": int(rlo.shape[0]), "wc": int(rlo.shape[1]),
             "devices": 1,
         }
+        # recorded only when compacted: legacy f32 spec strings (and the
+        # primed cache keys built from them) stay byte-identical
+        if self._cube_dtype != "f32":
+            spec["cube_dtype"] = self._cube_dtype
+        return spec
 
     def _dispatch(self, table: IsotopePatternTable, flat_plan=None):
         """Async: enqueue one padded batch on device, return (device_out, n)."""
@@ -893,11 +1071,19 @@ class JaxBackend:
             return {"fused_full": lambda: self._dispatch(table)[0]}, {
                 "path": "mz_chunk"}
         plan = self._flat_plan(table)
-        variant, args, statics = self._flat_call(table, plan)
+        variant, fargs, statics = self._flat_call(table, plan)
         fn_attr, ext_base, n_ext, pos_ix = _VARIANTS[variant]
         fn = getattr(self, fn_attr)
         phases = {"fused_full": lambda: fn(
-            self._px_s, self._in_s, *args, **statics)}
+            self._px_s, self._in_s, *fargs, **statics)}
+        # the sub-phase probes index the tail below (n_valid / theor_ints /
+        # n_real) — strip the int8 scales (and their off-lattice n_real
+        # placeholder) first, and give them the expanded f32 cube the
+        # unfused probe fns expect
+        args = list(fargs)
+        if self._scales is not None:
+            args = args[:-1] if self._n_real is not None else args[:-2]
+        in_probe = self._in_f32()
         img_cfg = self.ds_config.image_generation
         ext_statics = {kk: v for kk, v in statics.items()
                        if kk in ("n_keep", "w_cap", "gc_width")}
@@ -909,7 +1095,7 @@ class JaxBackend:
         # the plan's ion-sorted order (side inputs below permuted to match)
         ext_args = list(args[: n_ext - 1]) + [None]
         phases["extract"] = lambda: ext_fn(
-            self._px_s, self._in_s, *ext_args)
+            self._px_s, in_probe, *ext_args)
         # the metric probes run on the PRODUCTION image block: the padded
         # (b, k, P_bucket) lattice grid with the traced real-pixel count
         # masking the centering, exactly like the fused graph
@@ -984,7 +1170,7 @@ class JaxBackend:
                     partial(extract_images_flat, n_pixels=self._n_pix_b))
             pos = flat_bound_ranks(self._mz_host, grid)
             imgs = self._extract_fn(
-                self._px_s, self._in_s, jax.device_put(pos),
+                self._px_s, self._in_f32(), jax.device_put(pos),
                 jax.device_put(r_lo), jax.device_put(r_hi))
         # smlint: host-sync-ok[image EXPORT; the annotated-subset fetch to host is the product of this method]
         imgs = np.array(imgs).reshape(b, k, -1)[:n, :, : self.ds.n_pixels]
@@ -1058,11 +1244,15 @@ class JaxBackend:
         self._grow_for_stream(plans)
         reps, seen = [], set()
         for t, plan in zip(tables, plans):
-            variant = self._variant_for(plan[7], plan[9])
+            b_eff = plan[8]
+            gc_eff = self._gc_width if b_eff == self.batch else self._gc_tail
+            variant = self._maybe_fuse(
+                self._variant_for(plan[7], plan[9]),
+                plan[5][1].shape[1], gc_eff, t.max_peaks)
             # each band w_cap bucket is its own executable
             bucket = (self._band_bucket(plan[9][1])
                       if variant == "band" else 0)
-            kind = (variant, plan[8], bucket)
+            kind = (variant, b_eff, bucket)
             if kind not in seen:
                 seen.add(kind)
                 reps.append((t, plan))
@@ -1102,6 +1292,8 @@ class JaxBackend:
              self.batch, bool(self._buckets)),
             (self.ds_config.image_generation.nlevels,
              self.ds_config.image_generation.do_preprocessing),
+            # ISSUE 18 knobs change the compiled program family
+            (self._cube_dtype, self._fused_mode),
             (jax.__version__, dev.platform, str(dev.device_kind)),
         ))
         return hashlib.sha256(blob.encode()).hexdigest()

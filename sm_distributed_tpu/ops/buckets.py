@@ -147,7 +147,7 @@ def effective_batch(parallel_cfg) -> int:
 _SPEC_KEYS = (
     # identity of one concrete executable in the lattice
     "kind",               # "flat" | "sharded" | "chunked"
-    "variant",            # "plain" | "compact" | "band" | "step"
+    "variant",            # "plain" | "compact" | "band" | "fused" | "step"
     "nrows", "ncols",     # bucketed rows x exact columns (metric geometry)
     "nlevels", "do_preprocessing", "q",
     "n_resident",         # bucketed resident peak slots (per shard row)
@@ -162,6 +162,9 @@ _SPEC_KEYS = (
     "mesh_pix", "mesh_form",  # mesh axis sizes (pixels x formulas)
     "p_loc",              # per-shard pixel capacity (whole bucketed rows)
     "w",                  # total window count (the inv permutation length)
+    # compacted-cube executables only (ISSUE 18) — recorded only when
+    # parallel.cube_dtype != "f32", so legacy spec keys stay byte-stable:
+    "cube_dtype",         # "bf16" | "int8" resident intensity dtype
 )
 
 

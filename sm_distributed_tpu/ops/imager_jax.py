@@ -716,6 +716,8 @@ def fused_score_cost_model(
     formula_batch: int,
     nlevels: int = 30,
     ordered: bool = True,
+    fused: bool = False,
+    cube_dtype: str = "f32",
 ) -> dict:
     """Minimum-work estimate of one full scoring rep (all ions once), for
     the roofline probe (scripts/roofline_probe.py, ISSUE 3 satellite).
@@ -740,14 +742,50 @@ def fused_score_cost_model(
     device peaks is the roofline floor.  This is a LOWER bound on work (it
     prices no padding, no recompiles, no host/dispatch), so
     measured/modeled is an upper bound on remaining headroom.
+
+    ``fused=True`` prices the ISSUE 18 single-pass Pallas variant
+    (ops/score_pallas.py) instead of the unfused gather/segment-sum chain:
+    the (B, K, P) image block never round-trips HBM — the kernel stages
+    the histogram band in VMEM (two passes: moments, then centered
+    epilogue), writes only the (C, Wc, 5) moment partials plus the (B, P)
+    principal images the chaos sweep needs, and the epilogue reads
+    principal rather than the full K-peak block.  ``cube_dtype`` prices
+    the resident intensity read of the histogram scatter at the compacted
+    width (ops/quantize.py: bf16 2 B, int8 1 B per peak).
     """
     n_batches = max(1, -(-n_ions // formula_batch))
     g = 2 * formula_batch * max_peaks
     scratch_cols = max(g + 1, 4098)
     scatter_slots = (resident_peaks if ordered
                      else resident_peaks * n_batches)
-    scatter_bytes = 12 * scatter_slots
+    int_bytes = {"f32": 4, "bf16": 2, "int8": 1}[cube_dtype]
+    # per slot: intensity read + index read + f32 scratch read-modify-write
+    scatter_bytes = (int_bytes + 8) * scatter_slots
     init_bytes = 4 * n_batches * (n_pixels + 1) * scratch_cols
+    if fused:
+        # two VMEM-staged passes over the (g+1, P) histogram band; chunk
+        # band overlap (~16 rows per chunk) is noise at this granularity
+        band_read_bytes = 2 * 4 * n_batches * (g + 1) * n_pixels
+        image_bytes = 4 * n_ions * n_pixels          # principal write only
+        metric_read_bytes = 2 * image_bytes          # chaos ~2 passes
+        # membership dot runs in BOTH kernel passes; the centered-epilogue
+        # dots add 2*2 flops per (ion, peak, pixel) cell
+        matmul_flops = (2 * 2.0 * n_batches * n_pixels * (g + 1)
+                        * formula_batch
+                        + 4.0 * n_ions * max_peaks * n_pixels)
+        total_bytes = (scatter_bytes + init_bytes + band_read_bytes
+                       + image_bytes + metric_read_bytes)
+        return dict(
+            n_batches=n_batches,
+            scatter_slots=int(scatter_slots),
+            scatter_bytes=int(scatter_bytes),
+            scratch_init_bytes=int(init_bytes),
+            band_read_bytes=int(band_read_bytes),
+            image_bytes=int(image_bytes),
+            metric_read_bytes=int(metric_read_bytes),
+            total_bytes=int(total_bytes),
+            matmul_flops=float(matmul_flops),
+        )
     image_bytes = 4 * n_ions * max_peaks * n_pixels
     metric_read_bytes = 3 * image_bytes    # moments 1x + chaos ~2 passes
     matmul_flops = 2.0 * n_batches * n_pixels * (g + 1) * formula_batch

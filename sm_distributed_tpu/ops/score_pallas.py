@@ -1,0 +1,259 @@
+"""Fused window-gather + MSM-moment Pallas kernel (ISSUE 18).
+
+The flat scoring path (models/msm_jax.fused_score_fn_flat_banded) is a
+chain of XLA dispatches over the same bytes: histogram scatter -> per-chunk
+band slice -> membership matmul -> materialized (B*K, P) image block ->
+moments kernel -> metric epilogues.  The image block round-trips HBM
+between the matmul and the moments pass — at DESI shapes that is ~1 GB
+written and ~1 GB re-read per 256-ion batch that the roofline ledger
+(docs/PERF.md) charges to pure memory traffic.
+
+This kernel fuses the band matmul WITH the moment reductions so each image
+tile lives only in VMEM: grid ``(C, 2, nt)`` — C m/z-sorted window chunks
+(the ``ion_window_chunks`` plan) x the exact two-pass centered-moment
+schedule x nt pixel tiles.  TPU grids run sequentially, so the per-chunk
+``(1, Wc, 5)`` partials block stays resident across the pass/tile steps
+and accumulates in place (flushed when the chunk index advances).  Only
+the PRINCIPAL image rows (chaos needs the full spatial layout of peak 0)
+are written back at full width — 1/K of the unfused image traffic.
+
+Banding is data-dependent (each chunk reads grid rows
+``[start_c, start_c + gc_width + 2)``), which Pallas expresses with
+SCALAR PREFETCH: the histogram is reshaped to ``(cols_p/SC, SC, P)``
+super-rows and the block index map fetches ``nsb`` super-rows starting at
+``starts[c] // SC`` — the in-kernel rank shift ``starts[c] - SC *
+(starts[c] // SC)`` re-aligns window ranks exactly like the unfused
+path's clamped ``dynamic_slice`` shift.
+
+Numerics: the membership matmul accumulates the same quantized-grid
+integer sums (< 2**24, order-free) at ``Precision.HIGHEST``, so principal
+images, pixel sums, maxima and positive counts — hence chaos and the
+spectral pattern match — are BIT-EXACT versus the unfused path; the
+centered norm/dot reductions tile in ``pt`` columns instead of XLA's tree,
+so the spatial correlation moves within the declared ulp ceiling.  The
+exact contracts are declared below and proven by tests/test_score_pallas.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..analysis.numerics import numerics_surface
+from ..analysis.surface import compile_surface
+
+NUMERICS = numerics_surface(__name__, {
+    # principal rows + sums/vmax/nn are exact integer-grid sums (any
+    # association order) at HIGHEST precision; normsq/dots re-associate
+    # per pixel tile -> same ulp class as the moments kernel it replaces.
+    "fused_window_moments":
+        "contract=ulp(16); test=tests/test_score_pallas.py::"
+        "test_fused_matches_unfused; padded=whp",
+})
+
+COMPILE_SURFACE = compile_surface(__name__, {
+    "fused_window_moments":
+        "statics=gc_width,k,interpret; buckets=one executable per "
+        "(cols_p, P) scratch x (C, Wc) chunk-plan shape; every dimension "
+        "rides the shape-bucket lattice (peak_bucket/row_bucket + the "
+        "formula_batch ladder), and starts/n_real are traced scalar-"
+        "prefetch operands, so dataset sizes inside a bucket share one "
+        "executable",
+})
+
+# f32 sublane height: the histogram super-row granularity.  The scalar-
+# prefetch block index map can only address whole blocks, so chunk bands
+# are fetched as nsb super-rows of SC grid rows and the <SC-row residual
+# start offset becomes an in-kernel rank shift.
+SC = 8
+# VMEM budget in f32 cells for one grid step's resident set (band tile +
+# membership + image tile + partials) — same scoped-VMEM envelope as
+# ops/moments_pallas._MAX_CELLS.
+_MAX_CELLS = 2 * 1024 * 1024
+# pixel-tile ladder (lanes): largest dividing tile wins
+_PT_LADDER = (4096, 2048, 1024, 512, 256, 128)
+
+
+def n_super_blocks(gc_width: int) -> int:
+    """Super-rows per chunk band: cover gc_width + 2 rows from any
+    within-super-row start offset, i.e. ceil((gc + 2 + SC - 1) / SC) —
+    the shift (<= SC - 1) eats into the first super-row."""
+    return (gc_width + 2 + 2 * (SC - 1)) // SC
+
+
+def cols_padded(g: int, gc_width: int) -> int:
+    """Histogram scratch rows for the fused path: the unfused scratch
+    width rounded up to whole super-rows, plus nsb - 1 spare super-rows so
+    ``starts // SC + nsb`` stays in bounds without clamping (starts <= g;
+    see the inequality chain in fused_window_moments)."""
+    base = max(g + 1, gc_width + 2)
+    return -(-base // SC) * SC + (n_super_blocks(gc_width) - 1) * SC
+
+
+def pick_tile(n_pix: int, wc: int, ipc: int, gc_width: int):
+    """Largest pixel tile (multiple of 128 dividing n_pix) whose resident
+    set fits the VMEM budget, or None when none fits / n_pix is off the
+    128-lane lattice (the caller then keeps the unfused path)."""
+    if n_pix <= 0 or n_pix % 128 != 0:
+        return None
+    rows = n_super_blocks(gc_width) * SC
+    for pt in _PT_LADDER:
+        if n_pix % pt != 0:
+            continue
+        cells = (rows * pt          # staged band tile
+                 + wc * rows        # membership matrix
+                 + wc * pt          # image tile
+                 + ipc * pt         # principal output block
+                 + wc * 5)          # partials block
+        if cells <= _MAX_CELLS:
+            return pt
+    return None
+
+
+def fused_fit(wc: int, ipc: int, n_pix: int, gc_width: int) -> bool:
+    """True when the fused kernel can run COMPILED for this plan shape."""
+    return pick_tile(n_pix, wc, ipc, gc_width) is not None
+
+
+def _fused_kernel(starts_ref, s3_ref, nr_ref, wh_ref, rlo_ref, rhi_ref,
+                  out_ref, prin_ref, *, ipc: int, k: int, pt: int):
+    """One (chunk, pass, tile) step.
+
+    Pass 0 accumulates sums/vmax/nn; pass 1 re-derives the image tile
+    (one extra VMEM matmul — memory-bound, the band tile is already
+    staged) and accumulates the centered normsq/dots with the mean taken
+    from the pass-0 sums.  The partials block's index map ignores
+    (pass, tile), so it stays VMEM-resident per chunk — the standard
+    Pallas accumulation pattern.  Principal rows are written on BOTH
+    passes (bit-identical values) so every visited output block is fully
+    defined.
+    """
+    ps = pl.program_id(1)
+    t = pl.program_id(2)
+    wc = ipc * k
+    c = pl.program_id(0)
+    rows = wh_ref.shape[0]
+    # re-align local window ranks to the fetched super-row origin: staged
+    # row r holds global grid row s3*SC + r, i.e. local rank r - shift
+    shift = starts_ref[c] - s3_ref[c] * SC
+
+    band = wh_ref[...]                                    # (nsb*SC, pt)
+    lo = rlo_ref[0, :] + shift                            # (Wc,)
+    hi = rhi_ref[0, :] + shift
+    gg = jax.lax.broadcasted_iota(jnp.int32, (wc, rows), 1)
+    d = ((gg > lo[:, None]) & (gg <= hi[:, None])).astype(jnp.float32)
+    # integer-grid sums < 2**24: exact in f32 at HIGHEST in any order
+    imgs = jnp.dot(d, band, precision=jax.lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32)    # (Wc, pt)
+    prin_ref[0] = imgs.reshape(ipc, k, pt)[:, 0, :]
+
+    @pl.when((ps == 0) & (t == 0))
+    def _init():
+        out_ref[0] = jnp.zeros((wc, 5), jnp.float32)
+
+    @pl.when(ps == 0)
+    def _pass0():
+        acc = out_ref[0]
+        # pad pixel columns are exact zeros (pad peaks scatter 0.0), so
+        # sums/vmax/nn need no n_real mask — same argument as the masked
+        # jnp moments (images >= 0: window sums of nonnegative intensity)
+        sums = acc[:, 0] + jnp.sum(imgs, axis=1)
+        vmax = jnp.maximum(acc[:, 3], jnp.max(imgs, axis=1))
+        nn = acc[:, 4] + jnp.sum((imgs > 0.0).astype(jnp.float32), axis=1)
+        out_ref[0] = jnp.stack([sums, acc[:, 1], acc[:, 2], vmax, nn],
+                               axis=1)
+
+    @pl.when(ps == 1)
+    def _pass1():
+        acc = out_ref[0]
+        nre = nr_ref[0]
+        mean = acc[:, 0:1] / nre.astype(jnp.float32)      # (Wc, 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, (wc, pt), 1) + t * pt
+        cent = jnp.where(col < nre, imgs - mean, 0.0)
+        c3 = cent.reshape(ipc, k, pt)
+        dots = jnp.sum(c3 * c3[:, 0:1, :], axis=2).reshape(wc)
+        normsq = jnp.sum(cent * cent, axis=1)
+        out_ref[0] = jnp.stack(
+            [acc[:, 0], acc[:, 1] + normsq, acc[:, 2] + dots,
+             acc[:, 3], acc[:, 4]], axis=1)
+
+
+@partial(jax.jit, static_argnames=("gc_width", "k", "interpret"))
+def fused_window_moments(whp, starts, r_lo_loc, r_hi_loc, n_real, *,
+                         gc_width: int, k: int, interpret: bool = False):
+    """Fused band-matmul + moments over every chunk of the plan.
+
+    Args:
+      whp: (cols_p, P) f32 histogram scratch, ``cols_p ==
+        cols_padded(g, gc_width)`` (whole super-rows; spare rows are
+        zero-initialized and never referenced by a window).
+      starts: (C,) i32 chunk grid offsets (``ion_window_chunks``).
+      r_lo_loc / r_hi_loc: (C, Wc) i32 local window rank bounds.
+      n_real: traced i32 scalar (or python int) — REAL pixel count for
+        the lattice-padded grid; pads past it are masked out of the
+        centered reductions exactly like the masked moments kernel.
+      gc_width / k: static band width and isotope-peak count.
+      interpret: run the Pallas interpreter (CPU fallback / tests).
+
+    Returns:
+      partials: (C, Wc, 5) f32 — columns (sums, normsq, dots, vmax, nn)
+        per window row, in the PLAN's chunk-sorted ion order.
+      principal: (C, ipc, P) f32 principal (peak-0) images per ion.
+    """
+    cols_p, n_pix = whp.shape
+    C, wc = r_lo_loc.shape
+    if cols_p % SC != 0:
+        raise ValueError(f"cols_p={cols_p} must be a multiple of SC={SC}")
+    if wc % k != 0:
+        raise ValueError(f"Wc={wc} not divisible by k={k}")
+    ipc = wc // k
+    pt = pick_tile(n_pix, wc, ipc, gc_width)
+    if pt is None:
+        if not interpret:
+            raise ValueError(
+                f"fused kernel unfit for n_pix={n_pix}, wc={wc}, "
+                f"gc_width={gc_width} (use fused_fit before dispatch)")
+        pt = n_pix  # interpreter has no lane-tiling constraint
+    nsb = n_super_blocks(gc_width)
+    nt = n_pix // pt
+
+    starts = starts.astype(jnp.int32)
+    # no-op while starts <= g (cols_padded guarantees room); same clamp
+    # role as the unfused path's start_eff = min(start, cols - (gc + 2))
+    s3 = jnp.minimum(starts // SC, np.int32(cols_p // SC - nsb))
+    nr = jnp.reshape(jnp.asarray(n_real, jnp.int32), (1,))
+
+    # the band start is data-dependent (scalar-prefetched), so the
+    # histogram operand uses ELEMENT-offset (Unblocked) indexing: row
+    # offset s3*SC is sublane-aligned, column offset t*pt lane-aligned
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,  # starts, s3, n_real
+        grid=(C, 2, nt),
+        in_specs=[
+            pl.BlockSpec((nsb * SC, pt),
+                         lambda c, ps, t, starts, s3, nr:
+                         (s3[c] * SC, t * pt),
+                         indexing_mode=pl.unblocked),
+            pl.BlockSpec((1, wc), lambda c, ps, t, *_: (c, 0)),
+            pl.BlockSpec((1, wc), lambda c, ps, t, *_: (c, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, wc, 5), lambda c, ps, t, *_: (c, 0, 0)),
+            pl.BlockSpec((1, ipc, pt), lambda c, ps, t, *_: (c, 0, t)),
+        ],
+    )
+    partials, principal = pl.pallas_call(
+        partial(_fused_kernel, ipc=ipc, k=k, pt=pt),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((C, wc, 5), jnp.float32),
+            jax.ShapeDtypeStruct((C, ipc, n_pix), jnp.float32),
+        ],
+        interpret=interpret,
+    )(starts, s3, nr, whp, r_lo_loc, r_hi_loc)
+    return partials, principal

@@ -29,6 +29,15 @@ TPU-native replacement for the reference's distributed runtime (SURVEY.md
 
 The whole step stays a single jitted program per dataset (static shapes), so
 multi-chip keeps the north star's one-fused-graph property per batch.
+
+ISSUE 18 scope note: the mesh step adopts the bf16 resident-cube
+compaction (per-shard rows cast on host, expanded to f32 in-graph at the
+top of the step), but NOT the fused Pallas scoring kernel — the step's
+all_to_all trades materialized image blocks between pixel shards, and the
+correlation moments need the post-shuffle global-pixel mean, so the fused
+kernel's image-free partials cannot cross the shuffle without a second
+collective pass.  int8 falls back to f32 here: per-tile scale vectors do
+not align with shard rows.
 """
 
 from __future__ import annotations
@@ -58,7 +67,7 @@ from ..ops.imager_jax import (
 from ..ops.isocalc import IsotopePatternTable
 from ..ops.metrics_jax import batch_metrics
 from ..utils import tracing
-from ..ops.quantize import quantize_window
+from ..ops.quantize import expand_cube_jnp, quantize_window
 from ..utils.config import DSConfig, SMConfig
 from ..utils.logger import logger
 from .mesh import FORMULAS_AXIS, PIXELS_AXIS, make_mesh, shard_map
@@ -136,6 +145,9 @@ def build_sharded_score_factory(
         # band start), 0/0 the plain path.  One executable per
         # (gc_width, n_keep, w_cap) triple, mirroring JaxBackend._VARIANTS.
         b, k = theor_ints.shape
+        # f32 view of a (possibly bf16-compacted) shard row — a no-op for
+        # legacy f32 residents, so that HLO is byte-identical (ISSUE 18)
+        in_s = expand_cube_jnp(in_s, None)
         if n_keep:
             px_loc, in_loc = compact_peaks(
                 px_s[0], in_s[0], run_pos[0], run_delta[0], n_b[0, 0],
@@ -294,6 +306,20 @@ class ShardedJaxBackend:
         if restrict_table is not None:
             mz_s, px_s, in_s = self._restrict_shards(
                 mz_s, px_s, in_s, restrict_table)
+        # resident-cube compaction (ISSUE 18): bf16 halves the per-shard
+        # HBM rows (expanded to f32 in-graph at the top of the step); int8
+        # per-tile scale vectors do not align with shard rows, so the mesh
+        # path falls back to exact f32 rather than silently mis-scale
+        self._cube_dtype = sm_config.parallel.cube_dtype
+        if self._cube_dtype == "int8":
+            logger.warning(
+                "parallel.cube_dtype=int8 is single-device only (per-tile "
+                "scales do not shard); mesh path keeps f32 residents")
+            self._cube_dtype = "f32"
+        if self._cube_dtype == "bf16":
+            import ml_dtypes  # jax dependency; baked into the image
+
+            in_s = in_s.astype(ml_dtypes.bfloat16)
         self._compaction = sm_config.parallel.peak_compaction
         self._band_mode = sm_config.parallel.band_slice
         self._n_keep = 0          # sticky compacted capacity (see JaxBackend)
@@ -572,7 +598,7 @@ class ShardedJaxBackend:
         dispatch and is warm for every later job of that lease shape."""
         gc, n_keep, w_cap = key
         img = self.ds_config.image_generation
-        return {
+        spec = {
             "kind": "sharded", "variant": variant,
             "nrows": int(self._nrows_metric), "ncols": int(self.ds.ncols),
             "nlevels": int(img.nlevels),
@@ -589,6 +615,11 @@ class ShardedJaxBackend:
             "mesh_form": int(self.mesh.shape[FORMULAS_AXIS]),
             "p_loc": int(self._p_loc),
         }
+        # recorded only when compacted, like JaxBackend._bucket_spec —
+        # legacy spec strings stay byte-stable
+        if self._cube_dtype != "f32":
+            spec["cube_dtype"] = self._cube_dtype
+        return spec
 
     def score_batch(self, table: IsotopePatternTable) -> np.ndarray:
         from ..models.msm_jax import to_numpy_global
@@ -629,7 +660,8 @@ class ShardedJaxBackend:
 
         def step(px_s, in_s, pos, rlo, rhi):
             return extract_images_flat(
-                px_s[0], in_s[0], pos[0], rlo, rhi, n_pixels=p_loc)
+                px_s[0], expand_cube_jnp(in_s[0], None), pos[0], rlo, rhi,
+                n_pixels=p_loc)
 
         if not hasattr(self, "_extract_fn"):
             self._extract_fn = jax.jit(shard_map(

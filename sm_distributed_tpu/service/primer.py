@@ -100,11 +100,26 @@ def _flat_lower_call(spec: dict):
         "q": float(spec["q"]),
     }
     fn = make_flat_jits(common)[spec["variant"]]
-    resident = [S((n,), i32), S((n,), f32)]
+    # compacted-cube specs (ISSUE 18): the resident intensity aval carries
+    # the recorded dtype, and int8 appends the per-tile scale vector after
+    # the traced n_real scalar — exactly JaxBackend._flat_call's tail
+    cube_dtype = spec.get("cube_dtype") or "f32"
+    in_dtype = {"f32": f32, "bf16": None, "int8": np.int8}[cube_dtype]
+    if in_dtype is None:
+        import ml_dtypes  # jax dependency; baked into the image
+
+        in_dtype = ml_dtypes.bfloat16
+    resident = [S((n,), i32), S((n,), in_dtype)]
     plan = [S((c,), i32), S((c, wc), i32), S((c, wc), i32), S((b,), i32),
             S((b, k), f32), S((b,), i32), S((), i32)]
+    if cube_dtype == "int8":
+        from ..ops.quantize import QTILE
+
+        plan = plan + [S((n // QTILE,), f32)]
     statics = dict(gc_width=int(spec["gc_width"]), b=b, k=k)
-    if spec["variant"] == "plain":
+    if spec["variant"] in ("plain", "fused"):
+        # the fused Pallas variant shares the plain call shape exactly —
+        # only the jitted program differs (models/msm_jax._VARIANTS)
         args = resident + [S((g,), i32)] + plan
     elif spec["variant"] == "band":
         args = resident + [S((), i32), S((g,), i32)] + plan
@@ -157,12 +172,20 @@ def _sharded_lower_call(spec: dict):
         return jax.ShapeDtypeStruct(
             shape, dtype, sharding=NamedSharding(mesh, part))
 
+    # bf16-compacted residents (ISSUE 18) record their dtype on the spec;
+    # int8 never reaches the mesh path (ShardedJaxBackend falls back)
+    if spec.get("cube_dtype") == "bf16":
+        import ml_dtypes  # jax dependency; baked into the image
+
+        in_dtype = ml_dtypes.bfloat16
+    else:
+        in_dtype = f32
     # run/band plan blocks mirror ShardedJaxBackend._dispatch: compact
     # ships (S, F*r_pad) run lists, band/plain ship (S, F) dummies/starts
     rp_w = form * r_pad if n_keep else form
     args = [
         S((pix, n), i32, P(PIXELS_AXIS, None)),            # px_s
-        S((pix, n), f32, P(PIXELS_AXIS, None)),            # in_s
+        S((pix, n), in_dtype, P(PIXELS_AXIS, None)),       # in_s
         S((pix, g), i32, P(PIXELS_AXIS, FORMULAS_AXIS)),   # pos
         S((c,), i32, P(FORMULAS_AXIS)),                    # starts
         S((c, wc), i32, P(FORMULAS_AXIS, None)),           # r_lo_loc

@@ -186,6 +186,20 @@ class ParallelConfig:
     # size maps into a closed, primeable signature set; "off" keeps exact
     # legacy shapes (one executable family per dataset size)
     shape_buckets: str = "auto"
+    # resident-cube intensity dtype (ISSUE 18, ops/quantize.compact_cube):
+    # "bf16" halves / "int8" quarters the HBM-resident flat sorted-peaks
+    # cube (1.85 GB f32 at DESI scale); the f32 view is a per-batch
+    # transient expanded inside the scoring jits.  Declared NUMERICS
+    # contracts bound the drift (NUMERICS_r02.json); "f32" is the exact
+    # legacy off-ramp.
+    cube_dtype: str = "f32"
+    # fused Pallas scoring kernel (ISSUE 18, ops/score_pallas.py): one
+    # VMEM-staged pass does window-gather + per-ion MSM moment partials,
+    # replacing the multi-dispatch gather/segment-sum chain.  "auto"
+    # fuses plain-variant batches on TPU when the plan shape fits the
+    # kernel's VMEM budget; "on" forces it everywhere (interpret-mode
+    # off-TPU — tests/sentinel); "off" keeps the unfused XLA chain.
+    fused_metrics: str = "auto"
 
 
 @dataclass(frozen=True)
@@ -655,7 +669,9 @@ class SMConfig:
                             ("band_slice", ("auto", "on", "off")),
                             ("peak_compaction", ("auto", "on", "off")),
                             ("isocalc_device", ("on", "off")),
-                            ("overlap_isocalc", ("auto", "on", "off"))):
+                            ("overlap_isocalc", ("auto", "on", "off")),
+                            ("cube_dtype", ("f32", "bf16", "int8")),
+                            ("fused_metrics", ("auto", "on", "off"))):
             v = getattr(self.parallel, knob)
             if v not in valid:
                 raise ValueError(

@@ -35,6 +35,10 @@ def test_report_schema_and_values():
         "n_ions", "n_pixels", "pixels_per_s", "isocalc_s",
         "isocalc_cold_s", "isocalc_workers", "patterns_per_s",
         "phases",
+        # ISSUE 18: roofline + resident-cube-compaction pins
+        "roofline_frac", "roofline_floor_s", "roofline_bound",
+        "fused", "cube_dtype", "resident_cube_bytes",
+        "resident_cube_bytes_f32",
     }
     # per-phase wall (ISSUE 5 satellite): the trajectory explains WHERE
     # time moved; stream_s appears only when the case config is passed
@@ -73,6 +77,37 @@ def test_report_schema_and_values():
     # memory stats, passed through when measure_jax captured them
     assert out["hbm_peak_bytes"] is None
     assert out["device_kind"] is None
+    # roofline/compaction pins (ISSUE 18): null when measure_roofline did
+    # not run, passed through when measured
+    assert out["roofline_frac"] is None
+    assert out["resident_cube_bytes"] is None
+
+
+def test_report_roofline_fields_pass_through():
+    prep, floor, jaxr = _fake_inputs()
+    jaxr.update(roofline_frac=0.62, roofline_floor_s=0.484,
+                roofline_bound="bandwidth", fused=True, cube_dtype="bf16",
+                resident_cube_bytes=462_000_000,
+                resident_cube_bytes_f32=924_000_000)
+    out = report(prep, floor, jaxr)
+    assert out["roofline_frac"] == 0.62
+    assert out["roofline_bound"] == "bandwidth"
+    assert out["fused"] is True and out["cube_dtype"] == "bf16"
+    # the compaction acceptance pin: compacted bytes at most half of f32
+    assert out["resident_cube_bytes"] * 2 <= out["resident_cube_bytes_f32"]
+
+
+def test_report_compile_split_phases():
+    prep, floor, jaxr = _fake_inputs()
+    jaxr["compile_split"] = {"trace_s": 0.4, "lower_s": 0.1,
+                             "cache_load_s": 0.0, "backend_compile_s": 1.5,
+                             "warmup_exec_s": 10.0}
+    out = report(prep, floor, jaxr)
+    assert out["phases"]["compile_trace_s"] == 0.4
+    assert out["phases"]["compile_lower_s"] == 0.1
+    assert out["phases"]["compile_cache_load_s"] == 0.0
+    assert out["phases"]["compile_backend_s"] == 1.5
+    assert out["phases"]["warmup_exec_s"] == 10.0
 
 
 def test_report_hbm_fields_pass_through():

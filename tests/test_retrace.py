@@ -124,6 +124,23 @@ def test_compile_trace_event_emitted(tracer):
     assert "signature" in ev["attrs"] and "dur_s" in ev["attrs"]
 
 
+def test_duration_buckets_accumulate(tracer):
+    """Warm-start attribution (ISSUE 18): a fresh compile lands nonzero
+    jaxpr-trace and backend-compile seconds in the census duration
+    buckets, and reset() zeroes them."""
+    zero = retrace.snapshot()["durations"]
+    assert set(zero) == {"trace_s", "lower_s", "cache_load_s",
+                         "backend_compile_s"}
+    assert all(v == 0.0 for v in zero.values())
+    jax.jit(lambda x: x * 5.0)(jnp.ones(9, jnp.float32))
+    dur = retrace.snapshot()["durations"]
+    assert dur["trace_s"] > 0.0
+    assert dur["backend_compile_s"] > 0.0
+    retrace.reset()
+    assert all(v == 0.0
+               for v in retrace.snapshot()["durations"].values())
+
+
 # ------------------------------------------------------ surface registry
 def test_compile_surface_registers_and_validates():
     entries = {"fn": "statics=none; buckets=one shape"}
