@@ -26,7 +26,9 @@ Usage::
 
 Internal subcommands (the sweep's crashable subprocesses):
 ``--consume-one QUEUE_DIR SM_CONFIG`` drains one job through a JobScheduler;
-``--publish-one QUEUE_DIR MSG_JSON`` publishes one message.
+``--publish-one QUEUE_DIR MSG_JSON`` publishes one message;
+``--stream-one QUEUE_DIR SM_CONFIG`` drains one STREAMING job while playing
+the instrument (chunked appends + finish) in the same crashable process.
 """
 
 from __future__ import annotations
@@ -47,6 +49,7 @@ sys.path.insert(0, str(REPO_ROOT))
 # (engine.index is imported lazily by storage.store, readpath only by the
 # server wiring — without these the read-plane failpoints would be invisible)
 import sm_distributed_tpu.engine.index  # noqa: F401,E402
+import sm_distributed_tpu.engine.stream  # noqa: F401,E402
 import sm_distributed_tpu.io.imzml  # noqa: F401,E402
 import sm_distributed_tpu.models.msm_basic  # noqa: F401,E402
 import sm_distributed_tpu.service.fleet  # noqa: F401,E402
@@ -315,6 +318,34 @@ SCENARIOS: list[Scenario] = [
              "cache-fill fault on the first read: the read still answers "
              "from the source segment and the retry warms the cache",
              env={"SM_CHAOS_READ": "1"}, expect="CHAOS-READ-OK"),
+    # --- live-acquisition streaming seams (ISSUE 19) -------------------
+    # phase "stream": the crashable subprocess claims a mode=stream job
+    # AND plays the instrument, appending the fixture's spectra in 3
+    # chunks + finish; every restart replays all chunks from seq 0, so
+    # the duplicate-delivery (lost-ack) path is exercised on EVERY
+    # recovery and exactly-once is proven by golden equality (a doubled
+    # pixel would change the scores)
+    Scenario("stream.chunk_append", "stream", "stream.chunk_append=crash@2",
+             "crash between the chunk tmp write and its rename "
+             "mid-acquisition; the unacked chunk is re-posted after "
+             "restart, lands exactly once, and the stream converges to "
+             "the batch golden",
+             sm={"service": {"stream": {"idle_timeout_s": 60.0,
+                                        "poll_interval_s": 0.05}}}),
+    Scenario("stream.manifest_commit", "stream",
+             "stream.manifest_commit=crash@2",
+             "crash after the chunk rename but before the manifest commit "
+             "(the lost-ack window); the duplicate re-delivery after "
+             "restart overwrites the stranded file idempotently — "
+             "exactly once, no doubled pixels",
+             sm={"service": {"stream": {"idle_timeout_s": 60.0,
+                                        "poll_interval_s": 0.05}}}),
+    Scenario("stream.finish", "stream", "stream.finish=crash@1",
+             "crash inside finish before the finished flag commits; the "
+             "re-posted finish is idempotent and the one-shot batch "
+             "scoring runs exactly once",
+             sm={"service": {"stream": {"idle_timeout_s": 60.0,
+                                        "poll_interval_s": 0.05}}}),
 ]
 
 SMOKE = ("ckpt.shard_write", "spool.complete", "storage.results_rename")
@@ -416,6 +447,47 @@ def cmd_fleet_one(queue_dir: str, sm_config_path: str) -> int:
         return 3
     finally:
         fc.shutdown(drain=False, timeout_s=5.0)
+
+
+def cmd_stream_one(queue_dir: str, sm_config_path: str) -> int:
+    """Drain one STREAMING job: the scheduler claims the mode=stream
+    message while THIS process (crashable at the stream.* seams) plays
+    the instrument — appending the fixture's spectra chunk by chunk into
+    the chunk log, then posting finish.  Each restart replays every chunk
+    from seq 0: the duplicate-delivery path the CRC idempotency absorbs."""
+    from sm_distributed_tpu.analysis import lockorder
+
+    lockorder.enable_from_env()
+    import threading
+
+    from sm_distributed_tpu.engine.daemon import annotate_callback
+    from sm_distributed_tpu.engine.stream import StreamIngest, stream_root
+    from sm_distributed_tpu.io.imzml import ImzMLReader
+    from sm_distributed_tpu.service.scheduler import JobScheduler
+    from sm_distributed_tpu.utils.config import SMConfig
+
+    sm = SMConfig.set_path(sm_config_path)
+    sched = JobScheduler(queue_dir, annotate_callback(sm), config=sm.service,
+                         trace_dir=sm.trace_dir)
+    sched.start()
+
+    def _feed():
+        with ImzMLReader(os.environ["SM_CHAOS_STREAM_SRC"]) as rd:
+            coords = rd.coordinates.tolist()
+            spectra = [rd.read_spectrum(i) for i in range(rd.n_spectra)]
+        n = len(coords)
+        edges = [0, n // 3, 2 * n // 3, n]
+        ingest = StreamIngest(stream_root(sm))
+        for seq in range(3):
+            lo, hi = edges[seq], edges[seq + 1]
+            ingest.append_chunk(DS_ID, seq, coords[lo:hi], spectra[lo:hi])
+            time.sleep(0.2)    # let a provisional re-rank start in between
+        ingest.finish(DS_ID)
+
+    threading.Thread(target=_feed, daemon=True).start()
+    ok = sched.wait_for_terminal(1, timeout_s=120.0)
+    sched.shutdown()
+    return 0 if ok else 3
 
 
 def cmd_publish_one(queue_dir: str, msg_path: str) -> int:
@@ -601,6 +673,13 @@ def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
     outputs: list[str] = []
     result = {"scenario": sc.key, "spec": sc.spec, "runs": 0, "ok": False}
 
+    env = dict(sc.env)
+    if sc.phase == "stream":
+        # the subprocess plays the instrument from the fixture file; the
+        # spooled message itself carries only the stream:// sentinel
+        env["SM_CHAOS_STREAM_SRC"] = msg["input_path"]
+        msg = dict(msg, mode="stream", input_path=f"stream://{DS_ID}")
+
     if sc.phase == "publish":
         msg_file = ctx.base / "msg.json"
         msg_file.write_text(json.dumps(msg))
@@ -620,13 +699,14 @@ def run_scenario(sc: Scenario, base: Path, msg: dict, golden,
         QueuePublisher(ctx.queue_dir).publish(msg)
 
     while result["runs"] < MAX_RUNS:
-        armed = sc.phase in ("consume", "fleet") and \
+        armed = sc.phase in ("consume", "fleet", "stream") and \
             result["runs"] < sc.spec_runs
         spec = sc.spec if armed else None
-        sub = "--fleet-one" if sc.phase == "fleet" else "--consume-one"
+        sub = {"fleet": "--fleet-one",
+               "stream": "--stream-one"}.get(sc.phase, "--consume-one")
         rc, out = _run_sub(
             [sub, str(ctx.queue_dir), str(ctx.sm_conf)], spec,
-            sc.env)
+            env)
         outputs.append(out)
         result["runs"] += 1
         if verbose:
@@ -792,6 +872,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--consume-one", nargs=2, metavar=("QUEUE_DIR", "SM_CONFIG"))
     ap.add_argument("--publish-one", nargs=2, metavar=("QUEUE_DIR", "MSG_JSON"))
     ap.add_argument("--fleet-one", nargs=2, metavar=("QUEUE_DIR", "SM_CONFIG"))
+    ap.add_argument("--stream-one", nargs=2, metavar=("QUEUE_DIR", "SM_CONFIG"))
     args = ap.parse_args(argv)
 
     if args.consume_one:
@@ -800,6 +881,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_publish_one(*args.publish_one)
     if args.fleet_one:
         return cmd_fleet_one(*args.fleet_one)
+    if args.stream_one:
+        return cmd_stream_one(*args.stream_one)
     if args.list_fps:
         for name, desc in sorted(failpoints.registered_failpoints().items()):
             print(f"{name:<26} {desc}")

@@ -212,6 +212,17 @@ if ! env JAX_PLATFORMS=cpu python scripts/replica_chaos.py --smoke; then
     exit 1
 fi
 
+# live-acquisition failover gate (ISSUE 19): two replicas over one shared
+# spool + work dir; SIGKILL and controller drain of the claim-owning
+# replica mid-acquisition must both hand the live stream job to the peer,
+# which resumes from the chunk-log checkpoint and converges BIT-IDENTICAL
+# (check_exact) to the one-shot batch report — exactly-once spool census,
+# exactly-once chunk ingest, zero debris
+if ! env JAX_PLATFORMS=cpu python scripts/stream_chaos.py --smoke; then
+    echo "check_tier1: FAIL — live-acquisition failover gate failed" >&2
+    exit 1
+fi
+
 # elastic-fleet smoke gate (ISSUE 11): a lock-order-instrumented
 # FleetController over bare replica subprocesses must scale 1→4 under a
 # traffic surge and drain back to 2 under cooldown, with every job done/

@@ -48,7 +48,16 @@ serving invariants after each mix:
   loses host h1 WHOLE mid-sweep (both its replicas SIGKILLed at once).
   All jobs terminal exactly once, p99 bounded, and the survivors' host
   watchdogs demonstrably evicted the dead host
-  (``sm_pod_host_evictions_total``).
+  (``sm_pod_host_evictions_total``);
+- **stream** (full matrix only, ISSUE 19): two live acquisitions chunked
+  over HTTP (``mode=stream`` + ``POST /datasets/<id>/pixels``) into TWO
+  replicas sharing one spool, while a batch burst contends for the
+  worker pool and readers poll a published dataset; one replica is
+  DRAINED mid-acquisition and its live stream hands off to the peer
+  without burning an attempt — provisional re-rank coverage must keep
+  pace with the instrument, every read answers 200 across the drain,
+  and both streams must converge bit-identically (``check_exact``) to
+  the batch report of the same spectra.
 
 Usage::
 
@@ -1234,7 +1243,7 @@ def mix_read(base: Path, fx: dict, n_readers: int = 6, reads_each: int = 30,
 
 
 def _wait_done(root: Path, msg_ids: list[str],
-               timeout_s: float = 120.0) -> None:
+               timeout_s: float = 120.0, label: str = "read") -> None:
     """Spool-census wait (works across replicas, unlike one /jobs view)."""
     deadline = time.time() + timeout_s
     want = set(msg_ids)
@@ -1244,15 +1253,222 @@ def _wait_done(root: Path, msg_ids: list[str],
             return
         bad = {p.stem for p in (root / "failed").glob("*.json")} & want
         if bad:
-            raise SweepError(f"read: writes dead-lettered: {sorted(bad)}")
+            raise SweepError(f"{label}: jobs dead-lettered: {sorted(bad)}")
         time.sleep(0.05)
-    raise SweepError(f"read: writes never drained: "
+    raise SweepError(f"{label}: jobs never drained: "
                      f"{sorted(want - done)}")
+
+
+def mix_stream(base: Path, fx: dict, n_batch: int = 6,
+               n_chunks: int = 3, n_readers: int = 2) -> None:
+    """Mixed live/batch/read plane (ISSUE 19): two live acquisitions
+    streamed chunk-by-chunk over HTTP into TWO in-process replicas sharing
+    one spool, while a batch burst contends for the worker pool and
+    readers poll the published golden dataset.  One replica is DRAINED
+    mid-acquisition: its live stream hands off to the peer without
+    burning an attempt (``stream.drain_handoff``) and resumes from the
+    committed chunk log.  Asserts: batch traffic never starves the
+    provisional re-ranks (coverage advances after every chunk group),
+    every read answers 200 across the drain, both streams converge
+    BIT-IDENTICALLY (``check_exact``) to the batch report of the same
+    spectra, every job lands terminal in ``done/`` exactly once, and the
+    sm_stream_* families + the stream-partial SLO are live."""
+    import pandas as pd
+
+    from sm_distributed_tpu.io.imzml import ImzMLReader
+    from sm_distributed_tpu.service.leases import owned_shards, shard_of
+
+    shards = 8
+    overrides = {"service": {
+        # 3 workers per replica: a live acquisition pins a worker for its
+        # whole lifetime, the rest keep the batch burst moving
+        "workers": 3,
+        "replicas": 2, "spool_shards": shards,
+        "replica_heartbeat_interval_s": 0.2,
+        "replica_stale_after_s": 1.5, "takeover_interval_s": 0.3,
+        "admission": {"max_queue_depth": 16, "max_tenant_inflight": 16},
+        "stream": {"idle_timeout_s": 30.0, "poll_interval_s": 0.02,
+                   "rescore_min_chunks": 1},
+    }}
+    h1 = Harness(base, "stream", sm_overrides=_merge(
+        dict(overrides), {"service": {"replica_id": "r1"}}))
+    h2 = Harness(base, "stream", sm_overrides=_merge(
+        dict(overrides), {"service": {"replica_id": "r2"}}))
+    try:
+        with ImzMLReader(fx["fast"]["input_path"]) as rd:
+            coords = rd.coordinates.tolist()
+            spectra = [tuple(a.tolist() for a in rd.read_spectrum(i))
+                       for i in range(rd.n_spectra)]
+        n = len(coords)
+        edges = [round(i * n / n_chunks) for i in range(n_chunks + 1)]
+        # batch golden of the SAME spectra — the convergence target AND
+        # the published dataset the read plane polls during acquisition
+        status, _hd, body = h1.submit(_msg(fx, "fast", "stream_gold"))
+        _check(status == 202, f"stream: golden submit shed ({status})")
+        gold_id = body["msg_id"]
+        _wait_done(h1.root, [gold_id], label="stream")
+        batch_ids = [gold_id]
+        # one acquisition shard-owned by EACH replica, so the drain below
+        # demonstrably hands a live stream across the replica boundary
+        r2_shards = owned_shards("r2", {"r1", "r2"}, shards)
+        cands = [f"stream_{c}" for c in "abcdefghijklmnop"]
+        ds_r1 = next(c for c in cands if shard_of(c, shards) not in r2_shards)
+        ds_r2 = next(c for c in cands if shard_of(c, shards) in r2_shards)
+        streams = (ds_r1, ds_r2)
+        owner = {ds_r1: h1, ds_r2: h2}
+        stream_ids = {}
+        for ds in streams:
+            msg = {"ds_id": ds, "msg_id": ds, "mode": "stream",
+                   "formulas": fx["fast"]["formulas"],
+                   "ds_config": fx["fast"]["ds_config"]}
+            status, _hd, body = h1.submit(msg)
+            _check(status == 202, f"stream: {ds} submit shed ({status})")
+            stream_ids[ds] = body["msg_id"]
+        # read plane: readers poll the golden's published annotations on
+        # both replicas for the whole acquisition — every read must
+        # answer 200, including across the drain
+        paths = ["/datasets", "/datasets/stream_gold/annotations?limit=3",
+                 "/datasets/stream_gold/annotations?order=msm"]
+        targets = [h1.base, h2.base]
+        stop_reads = threading.Event()
+        reads: list[int] = []
+        reads_lock = threading.Lock()
+
+        def _reader(seed: int) -> None:
+            i = seed
+            while not stop_reads.is_set():
+                ts = list(targets)
+                try:
+                    status, _hd, _b = _http(ts[i % len(ts)], "GET",
+                                            paths[i % len(paths)])
+                except OSError:
+                    status = -1       # connection-level failure: fail loud
+                with reads_lock:
+                    reads.append(status)
+                i += 1
+                time.sleep(0.02)
+
+        readers = [threading.Thread(target=_reader, args=(i,))
+                   for i in range(n_readers)]
+        for t in readers:
+            t.start()
+        drained = False
+        for seq in range(n_chunks):
+            lo, hi = edges[seq], edges[seq + 1]
+            chunk = {"seq": seq, "coords": coords[lo:hi],
+                     "mzs": [s[0] for s in spectra[lo:hi]],
+                     "ints": [s[1] for s in spectra[lo:hi]]}
+            for ds in streams:
+                # every chunk lands on r1's ingest API — the shared work
+                # dir means ingest is not pinned to the claim owner
+                status, _hd, body = _http(
+                    h1.base, "POST", f"/datasets/{ds}/pixels", chunk)
+                _check(status == 200,
+                       f"stream: {ds} chunk {seq} rejected ({status} {body})")
+            # batch load lands BETWEEN chunk groups, contending for the
+            # spare workers while both streams re-rank
+            for _ in range(n_batch // n_chunks):
+                i = len(batch_ids)
+                status, _hd, body = h1.submit(
+                    _msg(fx, "fast", f"smix{i}", tenant=f"t{i % 3}"))
+                _check(status == 202, f"stream: batch {i} shed ({status})")
+                batch_ids.append(body["msg_id"])
+            # liveness under load: provisional coverage must reach this
+            # chunk group on both streams before the next one is acquired
+            # (polled on each stream's CLAIM OWNER — job records are
+            # per-replica in-memory; the spool is what's shared)
+            deadline = time.time() + 60.0
+            lagging = dict(stream_ids)
+            while lagging and time.time() < deadline:
+                for ds, mid in list(lagging.items()):
+                    _s, _hd, job = _http(owner[ds].base, "GET",
+                                         f"/jobs/{mid}")
+                    part = (job.get("partial") or {}).get("stream") or {}
+                    if part.get("chunks", 0) >= seq + 1:
+                        del lagging[ds]
+                time.sleep(0.05)
+            _check(not lagging,
+                   f"stream: re-rank starved under batch load at chunk "
+                   f"{seq}: {sorted(lagging)}")
+            if not drained:
+                # replica retired MID-ACQUISITION: r2 drains while its
+                # live stream still has chunks to come — the stream job
+                # must republish without burning an attempt and resume on
+                # r1 from the committed chunk log.  Out of read rotation
+                # first, a beat for issued reads to land, then drain.
+                drained = True
+                before = failpoints.recovery_counts().get(
+                    "stream.drain_handoff", 0)
+                targets[:] = [h1.base]
+                time.sleep(0.3)
+                h2.shutdown()
+                got = failpoints.recovery_counts().get(
+                    "stream.drain_handoff", 0)
+                _check(got > before,
+                       "stream: drain recorded no stream.drain_handoff")
+                owner[ds_r2] = h1
+        for ds in streams:
+            status, _hd, body = _http(h1.base, "POST",
+                                      f"/datasets/{ds}/finish", {})
+            _check(status == 200, f"stream: {ds} finish failed "
+                                  f"({status} {body})")
+        _wait_done(h1.root, batch_ids + list(stream_ids.values()),
+                   label="stream")
+        stop_reads.set()
+        for t in readers:
+            t.join(timeout=30.0)
+        _check(reads, "stream: read plane issued no reads")
+        bad_reads = sorted({s for s in reads if s != 200})
+        _check(not bad_reads,
+               f"stream: read plane saw non-200 outcomes {bad_reads}")
+        # bit-identity: each streamed report == the batch report of the
+        # same spectra, down to the last bit (the ISSUE 19 tentpole) —
+        # including the stream that crossed the replica boundary
+        def _report(ds):
+            out = []
+            for name in ("annotations.parquet", "all_metrics.parquet"):
+                df = pd.read_parquet(h1.dir / "results" / ds / name)
+                out.append(df.sort_values(["sf", "adduct"])
+                           .reset_index(drop=True))
+            return out
+        gold = _report("stream_gold")
+        for ds in streams:
+            got = _report(ds)
+            for label, g, w in zip(("annotations", "all_metrics"),
+                                   got, gold):
+                try:
+                    pd.testing.assert_frame_equal(g, w, check_exact=True)
+                except AssertionError as e:
+                    raise SweepError(
+                        f"stream: {ds} {label} not bit-identical to "
+                        f"batch: {str(e).splitlines()[-1]}") from e
+        text = h1.metrics_text()
+        chunks_total = sum(
+            float(ln.rsplit(" ", 1)[1]) for ln in text.splitlines()
+            if ln.startswith("sm_stream_chunks_total"))
+        _check(chunks_total == len(streams) * n_chunks,
+               f"stream: sm_stream_chunks_total {chunks_total} != "
+               f"{len(streams) * n_chunks}")
+        _check("sm_stream_reranks_total" in text
+               and "sm_stream_pixels_total" in text,
+               "stream: sm_stream_* families missing from /metrics")
+        _s, _hd, slo = _http(h1.base, "GET", "/slo")
+        _check("stream_partial" in slo.get("slos", {}),
+               "stream: stream_partial SLO missing from /slo")
+        h1.assert_clean("stream")
+        print(f"  stream: {len(streams)} live acquisitions x {n_chunks} "
+              f"chunks + {len(batch_ids)} batch jobs + {len(reads)} reads "
+              f"over 2 replicas, r2 drained mid-acquisition; provisional "
+              f"coverage kept pace, reports bit-identical to batch")
+    finally:
+        h1.shutdown()
+        h2.shutdown()
 
 
 # ------------------------------------------------------------------- driver
 def run_sweep(work: Path, smoke: bool = False, elastic_only: bool = False,
-              read_only: bool = False, pod_only: bool = False) -> int:
+              read_only: bool = False, pod_only: bool = False,
+              stream_only: bool = False) -> int:
     # lock-order detection (ISSUE 9): instrument every lock the service
     # stack creates below and fail the sweep on an acquisition-order cycle
     # — the load mixes drive scheduler workers, dispatcher, watchdog,
@@ -1274,6 +1490,9 @@ def run_sweep(work: Path, smoke: bool = False, elastic_only: bool = False,
         elif read_only:
             print("load sweep (read-plane stage)")
             mix_read(work, build_fixtures(work))
+        elif stream_only:
+            print("load sweep (live-acquisition stage)")
+            mix_stream(work, build_fixtures(work))
         else:
             fx = build_fixtures(work)
             h = Harness(work, "main")
@@ -1295,6 +1514,7 @@ def run_sweep(work: Path, smoke: bool = False, elastic_only: bool = False,
                 mix_replicas(work)
                 mix_pod(work)
                 mix_read(work, fx)
+                mix_stream(work, fx)
                 mix_elastic(work)
         rep = lockorder.assert_no_cycles("load sweep")
         print(f"lock-order: no cycles ({rep['locks_instrumented']} locks, "
@@ -1320,6 +1540,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="run only the pod host-loss mix (2 hosts x 2 "
                          "replicas, host h1 SIGKILLed whole mid-sweep, "
                          "exactly-once + p99 + watchdog-eviction asserts)")
+    ap.add_argument("--stream", action="store_true",
+                    help="run only the live-acquisition mix (two streams "
+                         "chunked over HTTP under a batch burst, provisional "
+                         "re-rank liveness, check_exact batch convergence)")
     ap.add_argument("--work", default=None)
     ap.add_argument("--keep", action="store_true")
     args = ap.parse_args(argv)
@@ -1330,7 +1554,8 @@ def main(argv: list[str] | None = None) -> int:
         tempfile.mkdtemp(prefix="sm_load_"))
     try:
         return run_sweep(work, smoke=args.smoke, elastic_only=args.elastic,
-                         read_only=args.read, pod_only=args.pod)
+                         read_only=args.read, pod_only=args.pod,
+                         stream_only=args.stream)
     except SweepError as exc:
         print(f"load sweep FAILED: {exc}", file=sys.stderr)
         return 1

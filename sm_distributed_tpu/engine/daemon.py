@@ -325,7 +325,16 @@ def annotate_callback(sm_config: SMConfig, residency=None):
         ds_config = (
             DSConfig.from_dict(msg["ds_config"]) if msg.get("ds_config") else DSConfig()
         )
-        job = SearchJob(
+        # live-acquisition streaming (ISSUE 19, engine/stream.py): a
+        # mode=stream message runs the long-lived stream attempt — same
+        # constructor contract, input comes from the chunk log instead of
+        # the message's input_path (a "stream://<ds_id>" sentinel)
+        job_cls = SearchJob
+        if msg.get("mode") == "stream":
+            from .stream import StreamSearchJob
+
+            job_cls = StreamSearchJob
+        job = job_cls(
             ds_id=msg["ds_id"],
             ds_name=msg.get("ds_name", msg["ds_id"]),
             input_path=msg["input_path"],

@@ -131,14 +131,7 @@ class SearchJob:
                 prefetch = IsotopePrefetch(
                     formulas, self.ds_config, self.sm_config,
                     str(Path(self.sm_config.work_dir) / "isocalc_cache"))
-            with phase_timer("stage_input", timings):
-                self.work_dir.copy_input_data(self.input_path)
-            if self.cancel is not None:
-                self.cancel.check("stage_input")
-            with phase_timer("read_dataset", timings):
-                ds = self._read_dataset()
-            if self.cancel is not None:
-                self.cancel.check("read_dataset")
+            ds = self._prepare_dataset(timings)
             logger.info(
                 "dataset %s: %dx%d px, %d spectra, %d peaks",
                 self.ds_id, ds.nrows, ds.ncols, ds.n_spectra, ds.n_peaks,
@@ -288,6 +281,24 @@ class SearchJob:
             self.on_partial(self.last_partial)
         except Exception:
             logger.warning("on_partial consumer failed", exc_info=True)
+
+    def _prepare_dataset(self, timings: dict[str, float]) -> SpectralDataset:
+        """Stage the input + parse it into the canonical CSR layout.  The
+        one overridable seam between job bookkeeping and scoring: a stream
+        job (engine/stream.py) assembles its dataset from the committed
+        chunk log instead of a staged imzML file, and everything else in
+        ``run`` — ledger rows, device hold, search, fences, storage — is
+        shared verbatim (which is what makes the end-of-acquisition pass
+        bit-identical to a batch submit)."""
+        with phase_timer("stage_input", timings):
+            self.work_dir.copy_input_data(self.input_path)
+        if self.cancel is not None:
+            self.cancel.check("stage_input")
+        with phase_timer("read_dataset", timings):
+            ds = self._read_dataset()
+        if self.cancel is not None:
+            self.cancel.check("read_dataset")
+        return ds
 
     def _read_dataset(self) -> SpectralDataset:
         """Parse the staged imzML — or reuse the residency cache's copy,

@@ -87,7 +87,13 @@ def validate_submit(msg) -> list[str]:
     if not isinstance(msg, dict):
         return ["message must be a JSON object"]
     errs = []
-    for req in ("ds_id", "input_path"):
+    if "mode" in msg and msg["mode"] not in ("batch", "stream"):
+        errs.append("'mode' must be \"batch\" or \"stream\"")
+    # a stream submit has no input file — the chunk log IS the input, so
+    # input_path is auto-filled with a "stream://<ds_id>" sentinel
+    required = (("ds_id",) if msg.get("mode") == "stream"
+                else ("ds_id", "input_path"))
+    for req in required:
         v = msg.get(req)
         if not isinstance(v, str) or not v:
             errs.append(f"{req!r} is required and must be a non-empty string")
@@ -232,6 +238,16 @@ class AdminAPI:
                             parts[1], raw=q.get("raw", ["0"])[0] not in
                             ("0", "", "false"))
                         self._reply_json(status, body)
+                    elif parts[0] == "jobs" and len(parts) == 2:
+                        # one record, partial preview included — the poll
+                        # surface a live acquisition watches its
+                        # provisional FDR ranking through (ISSUE 19)
+                        job = next((j for j in api.service.scheduler.jobs()
+                                    if j["msg_id"] == parts[1]), None)
+                        if job is None:
+                            self._reply_json(404, {"error": "not found"})
+                        else:
+                            self._reply_json(200, job)
                     else:
                         self._reply_json(404, {"error": "not found"})
                 except Exception as exc:  # noqa: BLE001
@@ -241,11 +257,22 @@ class AdminAPI:
 
             def do_POST(self):
                 try:
-                    if urlparse(self.path).path != "/submit":
+                    path = urlparse(self.path).path
+                    parts = path.strip("/").split("/")
+                    if path == "/submit":
+                        status, body, headers = api._submit(self._read_body())
+                        self._reply_json(status, body, headers)
+                    elif len(parts) == 3 and parts[0] == "datasets" \
+                            and parts[1] and parts[2] == "pixels":
+                        status, body, headers = api._stream_pixels(
+                            parts[1], self._read_body())
+                        self._reply_json(status, body, headers)
+                    elif len(parts) == 3 and parts[0] == "datasets" \
+                            and parts[1] and parts[2] == "finish":
+                        status, body = api._stream_finish(parts[1])
+                        self._reply_json(status, body)
+                    else:
                         self._reply_json(404, {"error": "not found"})
-                        return
-                    status, body, headers = api._submit(self._read_body())
-                    self._reply_json(status, body, headers)
                 except Exception as exc:  # noqa: BLE001
                     logger.error("admin-api: POST %s failed", self.path,
                                  exc_info=True)
@@ -327,9 +354,16 @@ class AdminAPI:
             return decision.status, decision.body(), \
                 {"Retry-After": str(max(1, int(round(decision.retry_after_s))))}
         try:
+            if msg.get("mode") == "stream" and not msg.get("input_path"):
+                # the scheduler/engine read the chunk log, never this path;
+                # the sentinel satisfies the publisher's contract and makes
+                # the dataset's provenance legible in the spool message
+                msg["input_path"] = f"stream://{msg['ds_id']}"
             # deadline propagation: pin the ABSOLUTE deadline at submit time
-            # so queueing delay counts against it end to end
-            if "deadline_s" in msg:
+            # so queueing delay counts against it end to end.  Stream jobs
+            # are exempt (ISSUE 19): an acquisition has no known length —
+            # their liveness bound is service.stream.idle_timeout_s
+            if "deadline_s" in msg and msg.get("mode") != "stream":
                 service_block = dict(msg.get("service", {}))
                 service_block.setdefault(
                     "deadline_at", time.time() + float(msg["deadline_s"]))
@@ -362,6 +396,82 @@ class AdminAPI:
                       priority=str(msg.get("priority", "normal")))
         return 202, {"msg_id": dst.stem, "spooled": str(dst),
                      "trace_id": trace["trace_id"]}, None
+
+    def _stream_pixels(self, ds_id: str,
+                       raw: bytes) -> tuple[int, dict, dict | None]:
+        """``POST /datasets/<id>/pixels`` (ISSUE 19): append one spectra
+        chunk to the dataset's crash-safe chunk log.  Body::
+
+            {"seq": 0, "coords": [[x, y], ...],
+             "mzs":  [[...], ...],  "ints": [[...], ...]}
+
+        Idempotent by ``seq`` — a byte-identical retry (lost ack) gets a
+        200 with ``duplicate: true``; a conflicting payload under the same
+        seq gets a 409.  Out-of-order seqs are fine."""
+        svc = self.service
+        ingest = getattr(svc, "stream_ingest", None)
+        if ingest is None:
+            return 404, {"error": "streaming ingest not configured",
+                         "reason": "not_found"}, None
+        if svc.stopping():
+            return 503, {"error": "service is draining",
+                         "reason": "stopping", "retry_after_s": 5.0}, \
+                {"Retry-After": "5"}
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError as exc:
+            return 400, {"error": f"malformed JSON: {exc}",
+                         "reason": "invalid_json"}, None
+        errs = []
+        if not isinstance(body, dict):
+            errs.append("body must be a JSON object")
+        else:
+            if not (isinstance(body.get("seq"), int)
+                    and not isinstance(body.get("seq"), bool)
+                    and body["seq"] >= 0):
+                errs.append("'seq' must be a non-negative integer")
+            for name in ("coords", "mzs", "ints"):
+                if not isinstance(body.get(name), list):
+                    errs.append(f"{name!r} must be a list")
+            if not errs and not (len(body["coords"]) == len(body["mzs"])
+                                 == len(body["ints"])):
+                errs.append("'coords', 'mzs' and 'ints' must have one entry "
+                            "per spectrum")
+        if errs:
+            return 400, {"error": "; ".join(errs),
+                         "reason": "invalid_message"}, None
+        from ..engine.stream import ChunkConflictError, StreamGapError
+        from .resources import ResourceBudgetError
+
+        try:
+            out = ingest.append_chunk(
+                ds_id, body["seq"], body["coords"],
+                list(zip(body["mzs"], body["ints"])))
+        except ChunkConflictError as exc:
+            return 409, {"error": str(exc), "reason": "chunk_conflict"}, None
+        except StreamGapError as exc:
+            return 409, {"error": str(exc), "reason": "stream_finished"}, None
+        except ResourceBudgetError as exc:
+            return 507, {"error": str(exc), "reason": "disk_budget",
+                         "retry_after_s": 5.0}, {"Retry-After": "5"}
+        except ValueError as exc:
+            return 400, {"error": str(exc), "reason": "invalid_message"}, None
+        return 200, {"ds_id": ds_id, **out}, None
+
+    def _stream_finish(self, ds_id: str) -> tuple[int, dict]:
+        """``POST /datasets/<id>/finish``: seal the acquisition.  409 when
+        the committed sequence has gaps; idempotent once sealed."""
+        ingest = getattr(self.service, "stream_ingest", None)
+        if ingest is None:
+            return 404, {"error": "streaming ingest not configured",
+                         "reason": "not_found"}
+        from ..engine.stream import StreamGapError
+
+        try:
+            out = ingest.finish(ds_id)
+        except StreamGapError as exc:
+            return 409, {"error": str(exc), "reason": "stream_gap"}
+        return 200, {"ds_id": ds_id, **out}
 
     def _trace(self, msg_id: str, raw: bool = False) -> tuple[int, dict]:
         """``GET /jobs/<id>/trace``: resolve msg_id → trace_id (scheduler

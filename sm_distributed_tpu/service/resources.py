@@ -68,6 +68,7 @@ service composition; with no governor installed every check is a single
 from __future__ import annotations
 
 import errno
+import json
 import os
 import threading
 import time
@@ -129,7 +130,9 @@ class ResourceGovernor:
                  tracing_cfg: TracingConfig | None = None,
                  metrics=None, replica_id: str = "",
                  read_cache_dir: str | Path | None = None,
-                 read_cache_max_bytes: int = 0):
+                 read_cache_max_bytes: int = 0,
+                 stream_dir: str | Path | None = None,
+                 stream_retention_age_s: float = 0.0):
         self.cfg = cfg
         self.tracing_cfg = tracing_cfg or TracingConfig()
         self.replica_id = replica_id
@@ -144,6 +147,10 @@ class ResourceGovernor:
         # ResourcesConfig — the read path owns its own sizing knob
         self.read_cache_dir = Path(read_cache_dir) if read_cache_dir else None
         self.read_cache_max_bytes = int(read_cache_max_bytes)
+        # live-acquisition chunk logs (ISSUE 19): dir + age flow from the
+        # server wiring (StreamConfig.retention_age_s) like the read cache
+        self.stream_dir = Path(stream_dir) if stream_dir else None
+        self.stream_retention_age_s = float(stream_retention_age_s)
         self._lock = threading.Lock()
         self._used = 0                # bytes under the roots, last scan
         self._pending = 0             # preflighted-but-not-rescanned bytes
@@ -478,6 +485,36 @@ class ResourceGovernor:
             victims += self._over_size_cap(list(d.glob("*.png")), cap)
         self._reap("read_cache", victims)
 
+    def _sweep_stream(self, now: float) -> None:
+        """Chunk-log retention (ISSUE 19).  Torn append tmps are fair game
+        after an hour; a dataset's whole log is reclaimed only once its
+        manifest says ``finished`` AND it has sat idle past
+        ``service.stream.retention_age_s`` — an in-flight acquisition is
+        never swept, no matter how old."""
+        d = self.stream_dir
+        age = self.stream_retention_age_s
+        if d is None or not d.is_dir():
+            return
+        self._reap("stream", self._aged(d.glob("*/.*.tmp"), 3600.0, now))
+        if age <= 0:
+            return
+        for ds_dir in sorted(d.iterdir()):
+            man = ds_dir / "manifest.json"
+            if not ds_dir.is_dir() or not man.is_file():
+                continue
+            try:
+                finished = bool(json.loads(man.read_text()).get("finished"))
+                idle = now - man.stat().st_mtime >= age
+            except (OSError, ValueError):
+                continue
+            if finished and idle:
+                self._reap("stream",
+                           sorted(ds_dir.glob("chunk_*.npz")) + [man])
+                try:
+                    ds_dir.rmdir()
+                except OSError:
+                    pass          # stray file left behind -> next tick
+
     def _sweep_registry(self, now: float) -> None:
         root = self.queue_root
         age = self.cfg.registry_retention_age_s
@@ -499,6 +536,7 @@ class ResourceGovernor:
         self._sweep_spool(now, owns_msg)
         self._sweep_cache(now)
         self._sweep_read_cache(now)
+        self._sweep_stream(now)
         self._sweep_registry(now)
         self.rescan_usage()
         with self._lock:

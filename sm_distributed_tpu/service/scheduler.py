@@ -74,7 +74,12 @@ from ..engine.daemon import (
 from ..models import faults
 from ..parallel.distributed import process_identity
 from ..utils import tracing
-from ..utils.cancel import CancelToken, DeadlineExceededError, JobCancelledError
+from ..utils.cancel import (
+    CancelToken,
+    DeadlineExceededError,
+    JobCancelledError,
+    StreamIdleError,
+)
 from ..utils.config import ServiceConfig
 from ..utils.failpoints import failpoint, record_recovery, register_failpoint
 from ..utils.logger import logger
@@ -409,6 +414,9 @@ class JobScheduler:
         self.m_quarantined = m.counter(
             "sm_jobs_quarantined_total",
             "Messages parked in quarantine/ after crash-looping claims")
+        self.m_stream_reranks = m.counter(
+            "sm_stream_reranks_total",
+            "Provisional stream re-ranks published via the partial seam")
         self.m_running = m.gauge(
             "sm_jobs_running", "Jobs currently executing in the worker pool")
         self.m_duration = m.histogram(
@@ -502,9 +510,19 @@ class JobScheduler:
     def _set_partial(self, rec: JobRecord, payload: dict) -> None:
         """Streamed first results (ISSUE 13): the running search published
         a provisional-annotation summary — surface it on the job record
-        so GET /jobs shows rankable results while later batches run."""
+        so GET /jobs shows rankable results while later batches run.
+        Stream re-ranks (ISSUE 19) ride the same seam with a ``stream``
+        coverage block; it feeds the re-rank counter and the chunk-commit
+        -> partial SLO histogram."""
         with self._records_lock:
             rec.partial = dict(payload or {})
+        stream = (payload or {}).get("stream")
+        if isinstance(stream, dict):
+            if self.metrics:
+                self.m_stream_reranks.inc()
+            lat = stream.get("commit_to_partial_s")
+            if self.slo is not None and lat is not None:
+                self.slo.observe_stream_partial(float(lat))
 
     def _note_terminal(self, rec: JobRecord) -> None:
         with self._records_lock:
@@ -807,6 +825,11 @@ class JobScheduler:
         """Absolute deadline for a message: ``service.deadline_at`` (set by
         the API from ``deadline_s`` at submit) wins; a raw ``deadline_s`` is
         anchored at publish time.  0 = no deadline."""
+        if isinstance(msg, dict) and msg.get("mode") == "stream":
+            # open-ended jobs (ISSUE 19): an acquisition has no known
+            # length, so a submit-pinned deadline is dead-on-arrival —
+            # liveness is bounded by service.stream.idle_timeout_s instead
+            return 0.0
         svc = msg.get("service", {}) if isinstance(msg, dict) else {}
         if svc.get("deadline_at"):
             return float(svc["deadline_at"])
@@ -919,7 +942,22 @@ class JobScheduler:
                 timeout_s = min(timeout_s, max(0.0, deadline_at - time.time()))
             t0 = time.perf_counter()
             attempt.start()
-            attempt.join(timeout=timeout_s)
+            if isinstance(msg, dict) and msg.get("mode") == "stream":
+                # open-ended attempt (ISSUE 19): an acquisition's wall
+                # clock is unknowable up front, so the flat per-attempt
+                # timeout does not apply — liveness is owned by
+                # stream.idle_timeout_s (raised inside the attempt) and
+                # the progress-reset stall watchdog, either of which
+                # cancels the token.  Once ANY cancel lands, an attempt
+                # that fails to unwind within cancel_grace_s falls
+                # through to the abandoned-thread handling below, same
+                # as a timed-out batch attempt.
+                while attempt.is_alive() and not token.cancelled():
+                    attempt.join(timeout=0.5)
+                if attempt.is_alive():
+                    attempt.join(timeout=self.cfg.cancel_grace_s)
+            else:
+                attempt.join(timeout=timeout_s)
             timed_out = attempt.is_alive()
             abandoned = False
             if timed_out:
@@ -973,6 +1011,12 @@ class JobScheduler:
                 # forfeit — the message (and its spool file) belongs to the
                 # takeover replica now
                 self._note_fenced(rec, token.reason or str(attempt.error))
+            elif isinstance(attempt.error, StreamIdleError):
+                # the acquisition went silent past its idle timeout —
+                # terminal like a deadline: retrying cannot conjure chunks
+                self._terminal_cancelled(
+                    claimed, msg, rec,
+                    str(attempt.error) + (" (abandoned)" if abandoned else ""))
             elif token.deadline_exceeded() or \
                     isinstance(attempt.error, DeadlineExceededError):
                 err = token.reason or str(attempt.error)
@@ -984,6 +1028,14 @@ class JobScheduler:
                     claimed, msg, rec,
                     (token.reason or "cancelled by user")
                     + (" (abandoned)" if abandoned else ""))
+            elif is_cancel_exc and isinstance(msg, dict) \
+                    and msg.get("mode") == "stream" \
+                    and str(token.reason or "").startswith("drain"):
+                # drain hand-off (ISSUE 19): the acquisition is alive and
+                # its chunk log durable — republish immediately with no
+                # backoff and no attempt burned, so a peer replica resumes
+                # from the streaming checkpoint
+                self._stream_handoff(claimed, msg, rec)
             elif timed_out or is_cancel_exc:
                 # timeout / watchdog stall — a normal failure under the
                 # retry policy (the next attempt may behave)
@@ -1059,6 +1111,7 @@ class JobScheduler:
                 "stalled" if reason.startswith("stalled") else
                 "fenced" if reason.startswith("fenced") else
                 "host_evicted" if reason.startswith("host") else
+                "drain" if reason.startswith("drain") else
                 "user" if "user" in reason else "timeout")
         if delivered:
             with self._records_lock:
@@ -1264,6 +1317,56 @@ class JobScheduler:
         logger.warning(
             "scheduler: %s attempt %d/%d failed (%s); retry in %.2fs",
             claimed.name, rec.attempts, max_attempts, error, delay)
+
+    def _stream_handoff(self, claimed: Path, msg: dict, rec: JobRecord) -> None:
+        """Drain hand-off of a live acquisition (ISSUE 19): the unwound
+        stream attempt's message goes straight back to pending/ so a peer
+        replica (this one stopped claiming) picks it up and resumes from
+        the streaming checkpoint — the chunk log + manifest + search
+        checkpoint shards, all durable and replica-agnostic.  Unlike a
+        retry: no backoff (the acquisition is live NOW) and no attempt
+        burned (the hand-off is controller-initiated, not a failure)."""
+        if not self._fence_ok(rec, "stream_handoff"):
+            return
+        rec.attempts = max(0, rec.attempts - 1)
+        rec.state = "queued"
+        rec.next_retry_at = 0.0
+        updated = dict(msg)
+        svc = dict(updated.get("service", {}))
+        svc["attempts"] = rec.attempts
+        svc.pop("next_retry_at", None)
+        svc["last_error"] = rec.error or "drain: stream hand-off"
+        updated["service"] = svc
+        tmp = self.root / "pending" / f".{claimed.name}.tmp"
+        tmp.write_text(json.dumps(updated, indent=2))
+        failpoint(FP_RETRY_PUBLISH, path=tmp)
+        os.replace(tmp, self.root / "pending" / claimed.name)
+        try:
+            claimed.unlink()
+        except FileNotFoundError:
+            pass
+        clear_heartbeat(claimed)
+        self._drop_lease(rec.msg_id, terminal=False)
+        record_recovery("stream.drain_handoff")
+        with self._records_lock:
+            hit = self._trace_roots.get(rec.msg_id)
+        tracing.event("stream.handoff", ctx=hit[0] if hit else None,
+                      replica=self.replica_id)
+        logger.info("scheduler: %s stream acquisition handed off to a peer "
+                    "(drain)", claimed.name)
+
+    def _cancel_live_streams(self, reason: str) -> None:
+        """Deliver a drain cancel to every live ``mode=stream`` attempt —
+        an open-ended acquisition never finishes on its own, so a draining
+        replica must actively unwind it into the hand-off path instead of
+        waiting out drain_timeout_s against an instrument."""
+        with self._records_lock:
+            live = [(mid, tok, att) for mid, (tok, att) in self._live.items()]
+        for msg_id, token, att in live:
+            m = getattr(att, "msg", None)
+            if isinstance(m, dict) and m.get("mode") == "stream" \
+                    and not token.cancelled():
+                self._deliver_cancel(token, self._record(msg_id), reason)
 
     def _dead_letter(self, claimed: Path, msg: dict, rec: JobRecord,
                      error: str, tb: str) -> None:
@@ -1473,6 +1576,10 @@ class JobScheduler:
         # them and complete the work exactly once
         failpoint(FP_DRAIN_HANDOFF)
         self._recompute_owned()
+        # live acquisitions hand off NOW — they would otherwise outlive
+        # the drain window waiting on the instrument (ISSUE 19)
+        self._cancel_live_streams(
+            "drain: handing off live acquisition to a peer")
         tracing.event("drain.begin", replica=self.replica_id,
                       claims=self.live_claims())
         logger.info("replica %s: drain requested — releasing shard "
@@ -1680,6 +1787,9 @@ class JobScheduler:
         wait for running jobs.  Returns True when fully drained in time."""
         timeout_s = self.cfg.drain_timeout_s if timeout_s is None else timeout_s
         self._stop.set()
+        # a live acquisition waits on the instrument indefinitely: unwind
+        # it into the hand-off path so the worker join below can finish
+        self._cancel_live_streams("drain: service shutting down")
         deadline = time.time() + timeout_s
         ok = True
         for t in self._threads:

@@ -95,6 +95,9 @@ class AnnotationService:
         # and the admission controller consult it without plumbing;
         # tracing's file gate makes trace appends the FIRST thing dropped.
         read_cache_dir = Path(self.sm_config.work_dir) / "read_cache"
+        from ..engine.stream import StreamIngest, stream_root
+
+        stream_dir = stream_root(self.sm_config)
         self.resources = ResourceGovernor(
             self.sm_config.resources,
             work_dir=self.sm_config.work_dir,
@@ -105,9 +108,16 @@ class AnnotationService:
             tracing_cfg=self.sm_config.tracing,
             metrics=self.metrics, replica_id=cfg.replica_id,
             read_cache_dir=read_cache_dir,
-            read_cache_max_bytes=cfg.read.cache_disk_max_bytes)
+            read_cache_max_bytes=cfg.read.cache_disk_max_bytes,
+            stream_dir=stream_dir,
+            stream_retention_age_s=cfg.stream.retention_age_s)
         set_governor(self.resources)
         tracing.set_file_gate(self.resources.trace_gate)
+        # live-acquisition ingest (ISSUE 19, engine/stream.py): the HTTP
+        # chunk seam (POST /datasets/<id>/pixels|finish) appends into the
+        # crash-safe chunk log that StreamSearchJob re-scores from; shared
+        # work_dir means any replica can serve appends for any acquisition
+        self.stream_ingest = StreamIngest(stream_dir, metrics=self.metrics)
         # result read path (ISSUE 16, service/readpath.py): governed LRU +
         # segment reader + tile renderer behind the GET endpoints; cache
         # fills consult the governor's no-read-cache degrade level

@@ -351,6 +351,9 @@ class SLOTracker:
         self.h_read = registry.histogram(
             "sm_slo_read_seconds",
             "Read-path request latency (annotations/cohort/tile GETs)")
+        self.h_stream_partial = registry.histogram(
+            "sm_slo_stream_partial_seconds",
+            "Chunk commit -> provisional re-rank published, per re-rank")
         self._lock = threading.Lock()
         self._submits: dict[str, float] = {}     # job_id -> submit epoch
         self._first_noted: set[str] = set()
@@ -388,6 +391,12 @@ class SLOTracker:
         (429) are excluded; they are admission outcomes, not latency."""
         self.h_read.observe(max(0.0, seconds))
 
+    def observe_stream_partial(self, seconds: float) -> None:
+        """Streaming seam (ISSUE 19): one provisional re-rank became
+        visible on the partial channel, ``seconds`` after the newest chunk
+        it covers was committed to the acquisition manifest."""
+        self.h_stream_partial.observe(max(0.0, seconds))
+
     def observe_terminal(self, job_id: str, state: str,
                          submit_ts: float) -> None:
         """Scheduler seam: terminal outcome — close out the job."""
@@ -409,7 +418,9 @@ class SLOTracker:
                 ("first_annotation", self.h_first_annotation,
                  self.cfg.slo_first_annotation_s),
                 ("e2e", self.h_e2e, self.cfg.slo_e2e_s),
-                ("read", self.h_read, self.cfg.slo_read_s)):
+                ("read", self.h_read, self.cfg.slo_read_s),
+                ("stream_partial", self.h_stream_partial,
+                 self.cfg.slo_stream_partial_s)):
             attained, count = hist.fraction_below(objective_s)
             entry = {
                 "objective_s": objective_s,

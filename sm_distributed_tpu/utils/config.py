@@ -354,6 +354,39 @@ class ReadPathConfig:
 
 
 @dataclass(frozen=True)
+class StreamConfig:
+    """Live-acquisition streaming ingest (ISSUE 19, docs/SERVICE.md
+    "Streaming model"): ``mode=stream`` submits + ``POST
+    /datasets/<id>/pixels`` chunk appends into the crash-safe chunk log,
+    provisional re-scoring as coverage grows, and batch-identical
+    convergence at ``POST /datasets/<id>/finish``."""
+    idle_timeout_s: float = 300.0        # cancel an acquisition when no NEW
+                                         # chunk commits for this long (the
+                                         # stream analog of deadline_s —
+                                         # stream jobs are exempt from the
+                                         # submit-pinned absolute deadline);
+                                         # 0 waits forever
+    poll_interval_s: float = 0.25        # stream attempt's manifest poll
+                                         # cadence while waiting for chunks
+    rescore_min_chunks: int = 1          # provisional re-scores run only
+                                         # when at least this many NEW
+                                         # chunks committed since the last
+                                         # one (1 = re-score every commit)
+    retention_age_s: float = 3600.0      # finished/abandoned chunk logs
+                                         # older than this are removed by
+                                         # the governor's GC sweep
+                                         # (0 = keep forever)
+
+    def __post_init__(self):
+        if self.idle_timeout_s < 0 or self.retention_age_s < 0:
+            raise ValueError(
+                "stream: idle_timeout_s/retention_age_s must be >= 0")
+        if self.poll_interval_s <= 0 or self.rescore_min_chunks < 1:
+            raise ValueError("stream: poll_interval_s must be positive and "
+                             "rescore_min_chunks >= 1")
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """Annotation-service knobs (scheduler + failure policy + admin API) —
     the serving-side analog of the reference's rabbitmq/daemon settings.
@@ -467,6 +500,7 @@ class ServiceConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     prime: PrimeConfig = field(default_factory=PrimeConfig)
     read: ReadPathConfig = field(default_factory=ReadPathConfig)
+    stream: StreamConfig = field(default_factory=StreamConfig)
 
     def __post_init__(self):
         if self.workers <= 0 or self.max_attempts <= 0:
@@ -525,6 +559,8 @@ class TelemetryConfig:
     slo_first_annotation_s: float = 120.0  # submit -> first scored group
     slo_e2e_s: float = 600.0             # submit -> terminal outcome
     slo_read_s: float = 0.25             # read request -> response (ISSUE 16)
+    slo_stream_partial_s: float = 30.0   # stream chunk commit -> provisional
+                                         # partial served (ISSUE 19)
     slo_target: float = 0.99
 
     def __post_init__(self):
@@ -532,7 +568,8 @@ class TelemetryConfig:
             raise ValueError(
                 "telemetry: sample_interval_s/timeseries_len must be positive")
         if min(self.slo_queue_wait_s, self.slo_first_annotation_s,
-               self.slo_e2e_s, self.slo_read_s) <= 0:
+               self.slo_e2e_s, self.slo_read_s,
+               self.slo_stream_partial_s) <= 0:
             raise ValueError("telemetry: SLO thresholds must be positive")
         if not 0.0 < self.slo_target < 1.0:
             raise ValueError("telemetry: slo_target must be in (0, 1)")
@@ -718,4 +755,5 @@ _DATACLASS_FIELDS = {
     ("ServiceConfig", "fleet"): FleetConfig,
     ("ServiceConfig", "prime"): PrimeConfig,
     ("ServiceConfig", "read"): ReadPathConfig,
+    ("ServiceConfig", "stream"): StreamConfig,
 }
