@@ -43,13 +43,21 @@ Three pieces, each crash-safe on its own:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
+import threading
 import time
+import uuid
 import zlib
 from pathlib import Path
 
 import numpy as np
+
+try:                                  # posix; ThreadingHTTPServer replicas
+    import fcntl                      # share the stream root via flock
+except ImportError:                   # pragma: no cover - non-posix fallback
+    fcntl = None
 
 from ..io.dataset import SpectralDataset
 from ..utils import tracing
@@ -82,6 +90,19 @@ class StreamGapError(ValueError):
     holes, so no batch-identical result can exist yet."""
 
 
+class StreamEmptyError(StreamGapError):
+    """finish() with ZERO committed chunks: an empty acquisition has no
+    pixels to annotate, so sealing it would only push a degenerate
+    dataset deep into the engine.  Rejected at the seal seam instead."""
+
+
+# process-local fallback when fcntl is unavailable: one lock per lock-file
+# path still serializes the ThreadingHTTPServer handler threads of a
+# single replica (the common deployment), just not cross-process peers
+_LOCAL_LOCKS: dict[str, threading.Lock] = {}
+_LOCAL_LOCKS_GUARD = threading.Lock()
+
+
 class ChunkLog:
     """Crash-safe, CRC-checksummed chunk log + monotone acquisition
     manifest for one streamed dataset.
@@ -95,6 +116,15 @@ class ChunkLog:
     ``sweep_debris`` reclaims torn ``.tmp`` leavings.  The manifest is
     monotone: entries are only ever added, and ``finished`` only ever
     flips true.
+
+    The manifest read-modify-write in ``append``/``finish`` is serialized
+    by an ``fcntl.flock`` on a per-dataset lock file: the admin API is a
+    ThreadingHTTPServer and N replicas serve appends over ONE shared
+    stream root, so without the lock two concurrent appends would each
+    read the old manifest and the loser's committed-and-acked entry would
+    vanish.  Tmp filenames carry a pid+uuid suffix for the same reason —
+    two same-seq appends must never interleave writes through one tmp
+    path and publish a corrupt chunk under a stale CRC.
     """
 
     def __init__(self, root: str | Path, ds_id: str):
@@ -102,6 +132,33 @@ class ChunkLog:
         self.dir = Path(root) / ds_id
         self.dir.mkdir(parents=True, exist_ok=True)
         self.manifest_path = self.dir / "manifest.json"
+        self.lock_path = self.dir / ".lock"
+
+    @contextlib.contextmanager
+    def _locked(self):
+        """Exclusive per-dataset critical section around the manifest
+        read-modify-write.  flock works across processes AND across the
+        handler threads of one process (each entry opens a fresh file
+        description), and auto-releases on close — a crashed holder never
+        wedges the acquisition."""
+        if fcntl is None:             # pragma: no cover - non-posix
+            with _LOCAL_LOCKS_GUARD:
+                lock = _LOCAL_LOCKS.setdefault(str(self.lock_path),
+                                               threading.Lock())
+            with lock:
+                yield
+            return
+        with open(self.lock_path, "a+b") as fh:
+            fcntl.flock(fh, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(fh, fcntl.LOCK_UN)
+
+    def _tmp(self, name: str) -> Path:
+        """Collision-free tmp path (pid + uuid): concurrent writers each
+        rename their OWN bytes, never a half-written shared file."""
+        return self.dir / f".{name}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
 
     # ------------------------------------------------------------ manifest
     def manifest(self) -> dict:
@@ -116,7 +173,7 @@ class ChunkLog:
         # here with the chunk file unpublished (harmless debris, swept)
         if fence is not None:
             fence()
-        tmp = self.dir / ".manifest.json.tmp"
+        tmp = self._tmp("manifest.json")
         tmp.write_text(json.dumps(m, indent=2, sort_keys=True))
         os.replace(tmp, self.manifest_path)
 
@@ -169,53 +226,66 @@ class ChunkLog:
                 f"stream: {len(coords)} coords for {len(spectra)} spectra")
         offsets, mzs, ints = self._pack(spectra)
         crc = self._crc(coords, offsets, mzs, ints)
-        m = self.manifest()
-        if m.get("finished"):
-            raise StreamGapError(
-                f"stream {self.ds_id}: acquisition already finished")
-        prev = m["chunks"].get(str(seq))
-        if prev is not None:
-            if int(prev["crc"]) != crc:
-                raise ChunkConflictError(
-                    f"stream {self.ds_id}: chunk {seq} re-posted with "
-                    f"different payload (crc {crc:#x} != {prev['crc']:#x})")
-            # lost-ack redelivery: the commit already happened, ack again
-            return {"seq": seq, "committed": True, "duplicate": True}
-        # disk-budget preflight (ISSUE 10) before any byte lands
-        from ..service import resources as _resources
+        # lock spans manifest read -> manifest commit: a concurrent
+        # same-dataset append sees THIS entry (duplicate/conflict checks
+        # stay truthful) and can never base its commit on a stale manifest
+        with self._locked():
+            m = self.manifest()
+            if m.get("finished"):
+                raise StreamGapError(
+                    f"stream {self.ds_id}: acquisition already finished")
+            prev = m["chunks"].get(str(seq))
+            if prev is not None:
+                if int(prev["crc"]) != crc:
+                    raise ChunkConflictError(
+                        f"stream {self.ds_id}: chunk {seq} re-posted with "
+                        f"different payload (crc {crc:#x} != {prev['crc']:#x})")
+                # lost-ack redelivery: the commit already happened, ack again
+                return {"seq": seq, "committed": True, "duplicate": True}
+            # disk-budget preflight (ISSUE 10) before any byte lands
+            from ..service import resources as _resources
 
-        est = coords.nbytes + offsets.nbytes + mzs.nbytes + ints.nbytes
-        _resources.preflight("stream.chunk_append", est + 4096)
-        tmp = self.dir / f".chunk_{seq:06d}.npz.tmp"
-        with open(tmp, "wb") as fh:
-            np.savez(fh, coords=coords, offsets=offsets, mzs=mzs, ints=ints)
-        failpoint(FP_CHUNK_APPEND, path=tmp)
-        os.replace(tmp, self.chunk_path(seq))
-        # the chunk file is durable but unpublished until the manifest
-        # commit below — the exactly-once seam chaos_sweep crashes at
-        failpoint(FP_MANIFEST_COMMIT, path=self.manifest_path)
-        m["chunks"][str(seq)] = {"count": len(spectra), "crc": crc,
-                                 "committed_at": time.time()}
-        self._commit_manifest(m, fence=fence)
+            est = coords.nbytes + offsets.nbytes + mzs.nbytes + ints.nbytes
+            _resources.preflight("stream.chunk_append", est + 4096)
+            tmp = self._tmp(f"chunk_{seq:06d}.npz")
+            with open(tmp, "wb") as fh:
+                np.savez(fh, coords=coords, offsets=offsets, mzs=mzs,
+                         ints=ints)
+            failpoint(FP_CHUNK_APPEND, path=tmp)
+            os.replace(tmp, self.chunk_path(seq))
+            # the chunk file is durable but unpublished until the manifest
+            # commit below — the exactly-once seam chaos_sweep crashes at
+            failpoint(FP_MANIFEST_COMMIT, path=self.manifest_path)
+            m["chunks"][str(seq)] = {"count": len(spectra), "crc": crc,
+                                     "committed_at": time.time()}
+            self._commit_manifest(m, fence=fence)
         return {"seq": seq, "committed": True, "duplicate": False}
 
     def finish(self, fence=None) -> dict:
-        """Seal the acquisition.  Requires a gap-free sequence 0..n-1;
-        idempotent once sealed."""
-        m = self.manifest()
-        seqs = sorted(int(s) for s in m["chunks"])
-        if seqs != list(range(len(seqs))):
-            missing = sorted(set(range((seqs[-1] + 1) if seqs else 0))
-                             - set(seqs))
-            raise StreamGapError(
-                f"stream {self.ds_id}: cannot finish with missing chunk "
-                f"seqs {missing} (committed: {len(seqs)})")
-        if m.get("finished"):
-            return {"finished": True, "duplicate": True, "chunks": len(seqs)}
-        failpoint(FP_FINISH, path=self.manifest_path)
-        m["finished"] = True
-        m["finished_at"] = time.time()
-        self._commit_manifest(m, fence=fence)
+        """Seal the acquisition.  Requires at least one committed chunk
+        and a gap-free sequence 0..n-1; idempotent once sealed."""
+        with self._locked():
+            m = self.manifest()
+            seqs = sorted(int(s) for s in m["chunks"])
+            if m.get("finished"):
+                return {"finished": True, "duplicate": True,
+                        "chunks": len(seqs)}
+            if not seqs:
+                # [] passes the gap check vacuously, but sealing an empty
+                # acquisition would push a zero-pixel dataset into the
+                # batch engine — reject here with a distinct reason
+                raise StreamEmptyError(
+                    f"stream {self.ds_id}: cannot finish with zero "
+                    f"committed chunks")
+            if seqs != list(range(len(seqs))):
+                missing = sorted(set(range(seqs[-1] + 1)) - set(seqs))
+                raise StreamGapError(
+                    f"stream {self.ds_id}: cannot finish with missing chunk "
+                    f"seqs {missing} (committed: {len(seqs)})")
+            failpoint(FP_FINISH, path=self.manifest_path)
+            m["finished"] = True
+            m["finished_at"] = time.time()
+            self._commit_manifest(m, fence=fence)
         return {"finished": True, "duplicate": False, "chunks": len(seqs)}
 
     # ------------------------------------------------------------- reading
@@ -374,6 +444,7 @@ class StreamSearchJob(SearchJob):
         log.sweep_debris()            # torn leftovers from a crashed appender
         formulas = None
         applied = 0                   # chunks covered by the last re-score
+        last_n = 0                    # chunk count at the last observation
         last_new = time.time()
         logger.info("stream %s: acquisition open (%d chunk(s) committed, "
                     "idle timeout %.0fs)", self.ds_id,
@@ -388,18 +459,25 @@ class StreamSearchJob(SearchJob):
             finished = bool(m.get("finished"))
             if finished:
                 break
-            if n > applied:
+            # the idle clock resets ONLY on a genuinely new commit
+            # (n > last_n), never on the mere existence of sub-threshold
+            # pending chunks — otherwise rescore_min_chunks > 1 with a
+            # dead client would refresh last_new forever and defeat the
+            # liveness bound
+            if n > last_n:
+                last_n = n
                 last_new = time.time()
-                if n - applied >= cfg.rescore_min_chunks:
-                    if formulas is None:
-                        formulas = self._load_formulas()
-                    self._provisional_rescore(m, formulas)
-                    applied = n
+            if n - applied >= cfg.rescore_min_chunks:
+                if formulas is None:
+                    formulas = self._load_formulas()
+                self._provisional_rescore(m, formulas)
+                applied = n
             elif cfg.idle_timeout_s > 0 and \
                     time.time() - last_new >= cfg.idle_timeout_s:
                 raise StreamIdleError(
                     f"stream idle: no chunk committed for "
-                    f"{cfg.idle_timeout_s:.0f}s ({n} chunk(s) applied)")
+                    f"{cfg.idle_timeout_s:.0f}s ({n} chunk(s) committed, "
+                    f"{applied} applied)")
             time.sleep(cfg.poll_interval_s)
         logger.info("stream %s: acquisition finished (%d chunks, %d px, "
                     "%d provisional re-rank(s)) — running batch convergence",

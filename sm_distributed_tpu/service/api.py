@@ -465,10 +465,12 @@ class AdminAPI:
         if ingest is None:
             return 404, {"error": "streaming ingest not configured",
                          "reason": "not_found"}
-        from ..engine.stream import StreamGapError
+        from ..engine.stream import StreamEmptyError, StreamGapError
 
         try:
             out = ingest.finish(ds_id)
+        except StreamEmptyError as exc:
+            return 409, {"error": str(exc), "reason": "stream_empty"}
         except StreamGapError as exc:
             return 409, {"error": str(exc), "reason": "stream_gap"}
         return 200, {"ds_id": ds_id, **out}

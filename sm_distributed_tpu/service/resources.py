@@ -132,7 +132,8 @@ class ResourceGovernor:
                  read_cache_dir: str | Path | None = None,
                  read_cache_max_bytes: int = 0,
                  stream_dir: str | Path | None = None,
-                 stream_retention_age_s: float = 0.0):
+                 stream_retention_age_s: float = 0.0,
+                 stream_idle_timeout_s: float = 0.0):
         self.cfg = cfg
         self.tracing_cfg = tracing_cfg or TracingConfig()
         self.replica_id = replica_id
@@ -151,6 +152,7 @@ class ResourceGovernor:
         # server wiring (StreamConfig.retention_age_s) like the read cache
         self.stream_dir = Path(stream_dir) if stream_dir else None
         self.stream_retention_age_s = float(stream_retention_age_s)
+        self.stream_idle_timeout_s = float(stream_idle_timeout_s)
         self._lock = threading.Lock()
         self._used = 0                # bytes under the roots, last scan
         self._pending = 0             # preflighted-but-not-rescanned bytes
@@ -487,12 +489,19 @@ class ResourceGovernor:
 
     def _sweep_stream(self, now: float) -> None:
         """Chunk-log retention (ISSUE 19).  Torn append tmps are fair game
-        after an hour; a dataset's whole log is reclaimed only once its
-        manifest says ``finished`` AND it has sat idle past
-        ``service.stream.retention_age_s`` — an in-flight acquisition is
-        never swept, no matter how old."""
+        after an hour.  A dataset's whole log is reclaimed once its
+        manifest says ``finished`` and it has sat idle past
+        ``service.stream.retention_age_s`` — OR, for an ABANDONED
+        acquisition (client vanished, finish never posted), once the
+        manifest has been idle past ``retention_age_s + idle_timeout_s``:
+        by then the stream job is certainly terminal (``StreamIdleError``
+        fires at most ``idle_timeout_s`` after the last commit), so the
+        chunk files can't keep eating governed disk forever.  When
+        ``idle_timeout_s`` is 0 the operator opted into open-ended
+        acquisitions and unfinished logs are never reaped."""
         d = self.stream_dir
         age = self.stream_retention_age_s
+        idle_timeout = self.stream_idle_timeout_s
         if d is None or not d.is_dir():
             return
         self._reap("stream", self._aged(d.glob("*/.*.tmp"), 3600.0, now))
@@ -504,12 +513,16 @@ class ResourceGovernor:
                 continue
             try:
                 finished = bool(json.loads(man.read_text()).get("finished"))
-                idle = now - man.stat().st_mtime >= age
+                idle_s = now - man.stat().st_mtime
             except (OSError, ValueError):
                 continue
-            if finished and idle:
+            reap = (idle_s >= age if finished
+                    else idle_timeout > 0 and idle_s >= age + idle_timeout)
+            if reap:
+                lock = ds_dir / ".lock"
                 self._reap("stream",
-                           sorted(ds_dir.glob("chunk_*.npz")) + [man])
+                           sorted(ds_dir.glob("chunk_*.npz"))
+                           + ([lock] if lock.exists() else []) + [man])
                 try:
                     ds_dir.rmdir()
                 except OSError:

@@ -110,7 +110,8 @@ class AnnotationService:
             read_cache_dir=read_cache_dir,
             read_cache_max_bytes=cfg.read.cache_disk_max_bytes,
             stream_dir=stream_dir,
-            stream_retention_age_s=cfg.stream.retention_age_s)
+            stream_retention_age_s=cfg.stream.retention_age_s,
+            stream_idle_timeout_s=cfg.stream.idle_timeout_s)
         set_governor(self.resources)
         tracing.set_file_gate(self.resources.trace_gate)
         # live-acquisition ingest (ISSUE 19, engine/stream.py): the HTTP

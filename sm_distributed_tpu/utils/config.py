@@ -372,10 +372,12 @@ class StreamConfig:
                                          # when at least this many NEW
                                          # chunks committed since the last
                                          # one (1 = re-score every commit)
-    retention_age_s: float = 3600.0      # finished/abandoned chunk logs
-                                         # older than this are removed by
-                                         # the governor's GC sweep
-                                         # (0 = keep forever)
+    retention_age_s: float = 3600.0      # finished chunk logs idle past
+                                         # this are removed by the
+                                         # governor's GC sweep; abandoned
+                                         # (never-finished) logs after
+                                         # retention_age_s + idle_timeout_s
+                                         # idle (0 = keep forever)
 
     def __post_init__(self):
         if self.idle_timeout_s < 0 or self.retention_age_s < 0:

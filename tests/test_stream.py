@@ -21,6 +21,7 @@ from sm_distributed_tpu.engine.daemon import annotate_callback
 from sm_distributed_tpu.engine.stream import (
     ChunkConflictError,
     ChunkLog,
+    StreamEmptyError,
     StreamGapError,
     StreamIngest,
 )
@@ -91,6 +92,68 @@ def test_chunk_log_duplicate_and_out_of_order(tmp_path):
     assert log.finish()["duplicate"] is True          # finish is idempotent
     with pytest.raises(StreamGapError):               # post-finish append
         log.append(3, [[2, 0]], [([140.0], [6.0])])
+
+
+def test_chunk_log_concurrent_appends_lose_nothing(tmp_path):
+    """Regression: the manifest read-modify-write must be serialized (a
+    per-dataset flock) — the admin API is a ThreadingHTTPServer and
+    replicas share the stream root, so two concurrent appends that each
+    read the old manifest would otherwise ack chunks whose entries then
+    vanish, wedging finish() forever (the client never re-posts an acked
+    seq)."""
+    import concurrent.futures
+
+    n_chunks = 24
+    def post(seq):
+        # a fresh ChunkLog per call models independent handler threads /
+        # replica processes — no shared in-memory state to hide behind
+        return ChunkLog(tmp_path, "ds1").append(
+            seq, [[seq, 0]], [([100.0 + seq], [1.0])])
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        outs = list(ex.map(post, range(n_chunks)))
+    assert all(o["committed"] and not o["duplicate"] for o in outs)
+    log = ChunkLog(tmp_path, "ds1")
+    # every acked append survives in the manifest: no lost entries
+    assert log.committed_seqs() == list(range(n_chunks))
+    assert log.finish()["finished"] is True
+    ds = log.assemble_dataset()
+    assert ds.n_spectra == n_chunks
+
+
+def test_chunk_log_concurrent_same_seq_appends_commit_once(tmp_path):
+    """Concurrent same-seq appends (redelivery racing the original) must
+    commit exactly once with an uncorrupted chunk — unique tmp names plus
+    the lock keep interleaved writers from publishing torn bytes."""
+    import concurrent.futures
+
+    payload = ([[0, 0], [0, 1]],
+               [([100.0, 200.0], [1.0, 2.0]), ([150.0], [3.0])])
+
+    def post(_):
+        return ChunkLog(tmp_path, "ds1").append(0, *payload)
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=6) as ex:
+        outs = list(ex.map(post, range(6)))
+    assert all(o["committed"] for o in outs)
+    assert sum(not o["duplicate"] for o in outs) == 1  # exactly-once
+    log = ChunkLog(tmp_path, "ds1")
+    assert log.committed_seqs() == [0]
+    coords, spectra = log.load_chunk(0)                # CRC-verified read
+    assert coords.tolist() == [[0, 0], [0, 1]]
+
+
+def test_chunk_log_finish_empty_rejected(tmp_path):
+    """finish() with zero committed chunks must not seal an empty
+    acquisition — [] passes the gap check vacuously, but the batch engine
+    cannot annotate zero pixels."""
+    log = ChunkLog(tmp_path, "ds1")
+    with pytest.raises(StreamEmptyError, match="zero committed chunks"):
+        log.finish()
+    assert not log.finished()
+    # the first real chunk unblocks the seal
+    log.append(0, [[0, 0]], [([100.0], [1.0])])
+    assert log.finish()["finished"] is True
 
 
 def test_chunk_log_torn_trailing_chunk_on_restart(tmp_path):
@@ -341,6 +404,32 @@ def test_stream_idle_timeout_and_deadline_exemption(fixture_path, tmp_path):
         svc.shutdown()
 
 
+def test_stream_idle_timeout_fires_below_rescore_threshold(fixture_path,
+                                                           tmp_path):
+    """Regression: with ``rescore_min_chunks > 1``, sub-threshold pending
+    chunks must NOT refresh the idle clock every tick — a client that
+    commits one chunk and dies would otherwise keep the job alive
+    forever.  The idle clock resets only on a genuinely new commit."""
+    path, truth = fixture_path
+    sm = _sm(tmp_path, stream=StreamConfig(idle_timeout_s=1.0,
+                                           poll_interval_s=0.02,
+                                           rescore_min_chunks=4))
+    svc, base = _service(tmp_path, sm)
+    try:
+        status, body = _req(base, "/submit", "POST", {
+            "ds_id": "live", "mode": "stream",
+            "formulas": truth.formulas[:3], "ds_config": ADDUCTS})
+        assert status == 202
+        coords, spectra = _read_spectra(path)
+        # one chunk — below the re-score threshold — then client death
+        assert _post_chunk(base, "live", 0, coords[:2], spectra[:2])[0] == 200
+        rec = _wait_job(base, body["msg_id"], ("cancelled",), timeout_s=30.0)
+        assert "idle" in rec["error"]
+        assert rec["attempts"] == 1                    # terminal, no retries
+    finally:
+        svc.shutdown()
+
+
 def test_stream_outlives_per_attempt_timeout(fixture_path, tmp_path):
     """Satellite 1, attempt-timeout leg: ``job_timeout_s`` bounds one
     BATCH attempt's wall clock, but an acquisition's wall clock is
@@ -478,5 +567,53 @@ def test_stream_http_validation_and_conflicts(fixture_path, tmp_path):
         assert _req(base, "/datasets/d/pixels", "POST", gap)[0] == 200
         status, body = _req(base, "/datasets/d/finish", "POST", {})
         assert status == 409 and body["reason"] == "stream_gap"
+        # finish with ZERO committed chunks -> distinct structured 409
+        status, body = _req(base, "/datasets/nothing/finish", "POST", {})
+        assert status == 409 and body["reason"] == "stream_empty"
     finally:
         svc.shutdown()
+
+
+# ------------------------------------------------------------- retention
+def test_governor_reaps_finished_and_abandoned_stream_logs(tmp_path):
+    """Regression: an abandoned acquisition (client vanished, finish never
+    posted) must not hold governed work_dir space forever — unfinished
+    logs are reaped once idle past retention_age_s + idle_timeout_s, by
+    which point the stream job is certainly terminal.  idle_timeout_s = 0
+    (open-ended) keeps unfinished logs forever, and an in-flight log
+    inside the abandonment window is untouched."""
+    import os
+
+    from sm_distributed_tpu.service.resources import ResourceGovernor
+    from sm_distributed_tpu.utils.config import ResourcesConfig
+
+    root = tmp_path / "work" / "stream"
+
+    def mklog(ds_id, finished, idle_s):
+        log = ChunkLog(root, ds_id)
+        log.append(0, [[0, 0]], [([100.0], [1.0])])
+        if finished:
+            log.finish()
+        old = time.time() - idle_s
+        os.utime(log.manifest_path, (old, old))
+        return log.dir
+
+    done = mklog("done", finished=True, idle_s=20.0)
+    abandoned = mklog("abandoned", finished=False, idle_s=45.0)
+    inflight = mklog("inflight", finished=False, idle_s=20.0)
+
+    gov = ResourceGovernor(ResourcesConfig(), work_dir=tmp_path / "work",
+                           stream_dir=root, stream_retention_age_s=10.0,
+                           stream_idle_timeout_s=30.0)
+    gov._sweep_stream(time.time())
+    assert not done.exists()                           # finished + idle
+    assert not abandoned.exists()                      # idle past 10 + 30
+    assert (inflight / "manifest.json").exists()       # inside the window
+
+    # idle_timeout_s = 0: open-ended acquisitions, never auto-abandoned
+    forever = mklog("forever", finished=False, idle_s=1e6)
+    gov0 = ResourceGovernor(ResourcesConfig(), work_dir=tmp_path / "work",
+                            stream_dir=root, stream_retention_age_s=10.0,
+                            stream_idle_timeout_s=0.0)
+    gov0._sweep_stream(time.time())
+    assert (forever / "manifest.json").exists()
