@@ -396,7 +396,10 @@ def measure_jax(cfg: BenchConfig, prep: dict, cache_dir: Path,
         logger.info("[%s] HBM peak: %.1f MB on %s", cfg.name,
                     hbm["hbm_peak_bytes"] / 2**20, hbm["device_kind"])
     roofline = measure_roofline(cfg, prep, backend, jax_rate)
+    profiled = measure_profiled(cfg, prep, backend,
+                                roofline["roofline_floor_s"], cache_dir)
     return dict(jax_rate=jax_rate, compile_dt=compile_dt,
+                **profiled,
                 compile_split=compile_split,
                 jax_spread=jax_spread, cache_entries=cache_entries,
                 warmup_retried=warmup_retried,
@@ -460,6 +463,62 @@ def measure_roofline(cfg: BenchConfig, prep: dict, backend,
         fused=fused_active, cube_dtype=cube_dtype,
         resident_cube_bytes=int(resident_peaks * int_bytes),
         resident_cube_bytes_f32=int(resident_peaks * 4))
+
+
+def measure_profiled(cfg: BenchConfig, prep: dict, backend,
+                     floor_s: float, cache_dir: Path) -> dict:
+    """Profiled stream (ISSUE 20): one extra full stream captured under
+    ``jax.profiler``, device time attributed by kernel class
+    (analysis/profiling.py — fused Pallas scoring kernel vs the
+    gather/segment-sum chain vs transfers).  Pins
+
+    - ``measured_roofline_frac``: the cost-model floor over the MEASURED
+      per-rep device seconds the scoring kernels took.  The modeled
+      ``roofline_frac`` above divides by end-to-end wall time, so it mixes
+      in host dispatch slack; this one is the device-only answer, and a
+      drop means the kernels themselves slowed down.
+    - ``kernel_time_frac``: scoring kernels' share of ALL device time in
+      the capture — falls when transfers/layout ops start eating the
+      device.
+
+    None-safe: a failed or empty capture (profiler unavailable on this
+    runtime) pins nulls and never fails the bench."""
+    from sm_distributed_tpu.analysis import profiling
+    from sm_distributed_tpu.utils.logger import logger
+
+    out: dict = {"measured_roofline_frac": None, "kernel_time_frac": None,
+                 "device_kernel_s": None, "profile_n_events": 0}
+    sess = profiling.ProfileSession(cache_dir / "profile" / cfg.name)
+    try:
+        sess.start()
+        try:
+            backend.score_batches(prep["batches"] * cfg.reps)
+        finally:
+            cap = sess.stop()
+    except Exception:
+        logger.warning("[%s] profiled stream failed; pinning nulls",
+                       cfg.name, exc_info=True)
+        return out
+    attr = cap.get("attribution") or {}
+    total = float(attr.get("total_device_s") or 0.0)
+    by = attr.get("by_class_s") or {}
+    kernel_s = float(by.get("fused_kernel", 0.0)) + \
+        float(by.get("score_chain", 0.0))
+    out["profile_n_events"] = int(attr.get("n_events", 0))
+    if total > 0 and kernel_s > 0:
+        out["measured_roofline_frac"] = round(
+            profiling.measured_roofline(floor_s, kernel_s / cfg.reps), 4)
+        out["kernel_time_frac"] = round(kernel_s / total, 4)
+        out["device_kernel_s"] = round(kernel_s, 4)
+        logger.info("[%s] profiled stream: %.3fs device in scoring kernels "
+                    "(%.1f%% of device time) -> measured roofline %.1f%%",
+                    cfg.name, kernel_s, 100 * out["kernel_time_frac"],
+                    100 * out["measured_roofline_frac"])
+    else:
+        logger.info("[%s] profiled stream: no attributable device events "
+                    "(%d total); pinning nulls", cfg.name,
+                    out["profile_n_events"])
+    return out
 
 
 def _stream_rate(backend, prep: dict, cfg: BenchConfig, label: str) -> dict:
@@ -669,6 +728,13 @@ def report(prep: dict, floor: dict, jaxr: dict, iso: dict | None = None,
         "roofline_frac": jaxr.get("roofline_frac"),
         "roofline_floor_s": jaxr.get("roofline_floor_s"),
         "roofline_bound": jaxr.get("roofline_bound"),
+        # ISSUE 20 pinned fields: the MEASURED roofline — model floor over
+        # profiled per-rep device seconds in the scoring kernels — and the
+        # scoring kernels' share of all captured device time.  Both fall
+        # when the kernels regress; None when the capture found nothing.
+        "measured_roofline_frac": jaxr.get("measured_roofline_frac"),
+        "kernel_time_frac": jaxr.get("kernel_time_frac"),
+        "device_kernel_s": jaxr.get("device_kernel_s"),
         "fused": jaxr.get("fused"),
         "cube_dtype": jaxr.get("cube_dtype"),
         "resident_cube_bytes": jaxr.get("resident_cube_bytes"),

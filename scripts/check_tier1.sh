@@ -223,6 +223,21 @@ if ! env JAX_PLATFORMS=cpu python scripts/stream_chaos.py --smoke; then
     exit 1
 fi
 
+# fleet observability gate (ISSUE 20): a 3-replica fleet over one shared
+# work dir, one replica SIGKILLed mid-scrape — /fleet/slo must stay a 200
+# partial view with per-replica scrape-error evidence (never a 500), and
+# once the victim goes stale the merged SLO must be BIT-EQUAL to a
+# recomputation from the union of the survivors' raw histogram buckets.
+# Then an on-demand /debug/profile capture during a running sharded job
+# must attribute device time to the fused Pallas scoring kernel BY NAME
+# and inject correlated device_kernel spans into the job trace; finally
+# the committed PROFILE_r*.json must carry the measured-roofline pins and a
+# degraded replay must trip both perf_sentinel bands.
+if ! env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py; then
+    echo "check_tier1: FAIL — fleet observability gate failed" >&2
+    exit 1
+fi
+
 # elastic-fleet smoke gate (ISSUE 11): a lock-order-instrumented
 # FleetController over bare replica subprocesses must scale 1→4 under a
 # traffic surge and drain back to 2 under cooldown, with every job done/

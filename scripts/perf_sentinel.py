@@ -60,7 +60,13 @@ _BENCH_RATE_KEYS = ("value", "patterns_per_s", "pixels_per_s",
                     # ISSUE 18: measured fraction of the roofline ceiling —
                     # falling further from the memory-bound floor is the
                     # regression direction
-                    "roofline_frac")
+                    "roofline_frac",
+                    # ISSUE 20: the profiler-MEASURED roofline (model floor
+                    # over per-rep device seconds in the scoring kernels)
+                    # and the scoring kernels' share of all captured device
+                    # time — both fall when the kernels regress or when
+                    # transfers start eating the device
+                    "measured_roofline_frac", "kernel_time_frac")
 _BENCH_TIME_KEYS = ("compile_s", "isocalc_s", "isocalc_cold_s",
                     "single_chip_compile_s",
                     # ISSUE 13: cleared-cache cold-start pins — the

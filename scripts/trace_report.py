@@ -22,6 +22,7 @@ total.  Usage::
     python scripts/trace_report.py --url http://127.0.0.1:8685 --job MSG_ID
     python scripts/trace_report.py TRACE.jsonl --json      # machine-readable
     python scripts/trace_report.py TRACE.jsonl --validate  # schema-gate too
+    python scripts/trace_report.py TRACE.jsonl --by-replica  # attribution
 """
 
 from __future__ import annotations
@@ -165,6 +166,57 @@ def summarize(records: list[dict]) -> dict:
     }
 
 
+def by_replica(records: list[dict]) -> dict:
+    """Per-replica attribution (ISSUE 20) from the ISSUE-8 replica stamps.
+
+    A trace that survived a takeover (or had device_kernel spans injected
+    by a profiling replica) holds records from several processes; this
+    groups the work by WHO ran it.  Records emitted before replica
+    identity existed (or by non-service tooling) land under "-".
+    """
+    out: dict[str, dict] = {}
+    for r in records:
+        rid = str(r.get("replica") or "-")
+        b = out.setdefault(rid, {
+            "spans": 0, "events": 0, "seconds": 0.0, "attempts": 0,
+            "device_kernel_s": 0.0, "phases": {}, "pids": set(),
+        })
+        if r.get("pid") is not None:
+            b["pids"].add(r["pid"])
+        if r.get("kind") == "span":
+            b["spans"] += 1
+            dur = float(r.get("dur", 0.0))
+            b["seconds"] += dur
+            if r.get("name") == "attempt":
+                b["attempts"] += 1
+            elif r.get("name") == "device_kernel":
+                b["device_kernel_s"] += dur
+            if (r.get("attrs") or {}).get("phase"):
+                ph = b["phases"]
+                ph[r["name"]] = ph.get(r["name"], 0.0) + dur
+        elif r.get("kind") == "event":
+            b["events"] += 1
+    for b in out.values():
+        b["pids"] = sorted(b["pids"])
+        b["seconds"] = round(b["seconds"], 6)
+        b["device_kernel_s"] = round(b["device_kernel_s"], 6)
+        b["phases"] = {k: round(v, 6) for k, v in sorted(b["phases"].items())}
+    return out
+
+
+def render_by_replica(br: dict) -> str:
+    lines = ["", "per-replica attribution:"]
+    lines.append(f"  {'replica':<14} {'spans':>6} {'events':>7} "
+                 f"{'span-s':>10} {'attempts':>8} {'device-s':>10}  phases")
+    for rid in sorted(br):
+        b = br[rid]
+        phases = ", ".join(f"{k}={v:.3f}s" for k, v in b["phases"].items())
+        lines.append(f"  {rid:<14} {b['spans']:>6} {b['events']:>7} "
+                     f"{b['seconds']:>10.3f} {b['attempts']:>8} "
+                     f"{b['device_kernel_s']:>10.3f}  {phases or '-'}")
+    return "\n".join(lines)
+
+
 def _pct(part: float, total: float) -> str:
     return f"{100.0 * part / total:5.1f}%" if total > 0 else "    -"
 
@@ -250,6 +302,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--validate", action="store_true",
                     help="also schema-validate every record (exit 1 on any "
                          "problem) — the trace smoke gate's mode")
+    ap.add_argument("--by-replica", action="store_true",
+                    help="append the per-replica attribution table (who ran "
+                         "each span, incl. injected device_kernel time)")
     args = ap.parse_args(argv)
     if bool(args.url) == bool(args.trace):
         ap.error("give exactly one of TRACE or --url/--job")
@@ -266,7 +321,15 @@ def main(argv: list[str] | None = None) -> int:
                   + "\n  ".join(problems), file=sys.stderr)
             return 1
     summary = summarize(records)
-    print(json.dumps(summary, indent=2) if args.json else render(summary))
+    if args.by_replica:
+        summary["by_replica"] = by_replica(records)
+    if args.json:
+        print(json.dumps(summary, indent=2))
+    else:
+        out = render(summary)
+        if args.by_replica:
+            out += render_by_replica(summary["by_replica"])
+        print(out)
     return 0
 
 

@@ -1,0 +1,207 @@
+"""On-demand device profiling: capture, attribution, measured roofline.
+
+The modeled roofline (``fused_score_cost_model``) says where the fused
+scoring kernel SHOULD sit; nothing had ever measured where it actually
+does.  This module closes that loop with a ``jax.profiler`` capture around
+in-flight work and a parser for the Chrome-trace artifact it writes
+(``<dir>/plugins/profile/<ts>/<host>.trace.json.gz``): device-op events are
+``ph == "X"`` slices whose ``args`` carry ``hlo_module`` / ``hlo_op``, with
+``ts``/``dur`` in microseconds on the profiler's own clock.
+
+Three consumers share it (docs/OBSERVABILITY.md "Device profiles"):
+
+- ``GET /debug/profile?seconds=`` (service/fleetview.py) captures around
+  whatever the scheduler is running and injects ``device_kernel`` spans
+  into the live job traces, so Perfetto shows host spans and device
+  kernels on one timeline;
+- ``bench.py`` captures one scored stream and pins
+  ``measured_roofline_frac`` (cost-model floor over MEASURED kernel time)
+  next to the modeled ``roofline_frac``;
+- ``scripts/fleet_smoke.py`` asserts a capture during a sharded job
+  attributes >= 1 named scoring kernel.
+
+Kernel classes are name-driven, matching how the engine builds its jits:
+the fused Pallas path dispatches through ``fused_score_fn_flat_fused`` /
+``fused_window_moments`` (models/msm_jax.py), the unfused chain through
+gather/segment-sum HLO ops inside the plain score modules.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import time
+from pathlib import Path
+
+KERNEL_CLASSES = ("fused_kernel", "score_chain", "transfer", "other")
+
+# module-name fragments that identify the fused Pallas scoring kernel's
+# jit (mode "on" forces it everywhere, interpret off-TPU — the smoke gate
+# relies on that to profile it on CPU)
+_FUSED_FRAGMENTS = ("fused_score_fn", "fused_window_moments")
+# the unfused scoring chain: plain score jits + the gather/segment-sum ops
+_SCORE_FRAGMENTS = ("score_fn", "score_batch", "spectral_metrics")
+_SCORE_OPS = ("gather", "scatter", "segment", "reduce-window")
+_TRANSFER_OPS = ("copy", "transpose", "all-gather", "all-reduce",
+                 "collective-permute", "infeed", "outfeed")
+
+
+def classify_kernel(module: str, op: str) -> str:
+    """Map an (hlo_module, hlo_op) pair to its kernel class."""
+    mod = (module or "").lower()
+    op_l = (op or "").lower()
+    if any(f in mod for f in _FUSED_FRAGMENTS):
+        return "fused_kernel"
+    if any(op_l.startswith(t) for t in _TRANSFER_OPS):
+        return "transfer"
+    if any(f in mod for f in _SCORE_FRAGMENTS) or \
+            any(t in op_l for t in _SCORE_OPS):
+        return "score_chain"
+    return "other"
+
+
+def find_trace_file(profile_dir: str | Path,
+                    exclude: set[str] | frozenset[str] = frozenset()) -> Path | None:
+    """Newest ``*.trace.json.gz`` under ``profile_dir`` not in ``exclude``
+    — the capture that just stopped, not a stale one from a prior run."""
+    pattern = os.path.join(str(profile_dir),
+                           "plugins", "profile", "*", "*.trace.json.gz")
+    fresh = [p for p in glob.glob(pattern) if p not in exclude]
+    if not fresh:
+        return None
+    return Path(max(fresh, key=lambda p: os.path.getmtime(p)))
+
+
+def parse_trace_file(path: str | Path) -> list[dict]:
+    """Device-op events from a profiler Chrome trace: every complete slice
+    (``ph == "X"``) whose args name an ``hlo_module``, as
+    ``{"module", "op", "class", "ts_us", "dur_us"}``.  Events without HLO
+    attribution (host runtime slices) are skipped — they are not device
+    kernel time."""
+    with gzip.open(path, "rt") as fh:
+        data = json.load(fh)
+    events = []
+    for e in data.get("traceEvents", ()):
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        module = args.get("hlo_module")
+        if not module:
+            continue
+        op = args.get("hlo_op") or e.get("name", "")
+        events.append({
+            "module": module, "op": op,
+            "class": classify_kernel(module, op),
+            "ts_us": float(e.get("ts", 0.0)),
+            "dur_us": float(e.get("dur", 0.0)),
+        })
+    return events
+
+
+def attribute_device_time(events: list[dict], top_n: int = 20) -> dict:
+    """Aggregate parsed events into the attribution the endpoints serve:
+    per-class device seconds + fractions, and a per-kernel table (grouped
+    by (module, op), top ``top_n`` by time)."""
+    by_class = {c: 0.0 for c in KERNEL_CLASSES}
+    by_kernel: dict[tuple[str, str], dict] = {}
+    for e in events:
+        dur_s = e["dur_us"] / 1e6
+        by_class[e["class"]] += dur_s
+        k = (e["module"], e["op"])
+        slot = by_kernel.get(k)
+        if slot is None:
+            slot = by_kernel[k] = {"module": k[0], "op": k[1],
+                                   "class": e["class"],
+                                   "device_s": 0.0, "count": 0}
+        slot["device_s"] += dur_s
+        slot["count"] += 1
+    total_s = sum(by_class.values())
+    kernels = sorted(by_kernel.values(),
+                     key=lambda k: k["device_s"], reverse=True)
+    for k in kernels:
+        k["device_s"] = round(k["device_s"], 9)
+    fractions = {c: (round(by_class[c] / total_s, 6) if total_s else 0.0)
+                 for c in KERNEL_CLASSES}
+    return {
+        "total_device_s": round(total_s, 9),
+        "by_class_s": {c: round(v, 9) for c, v in by_class.items()},
+        "by_class_frac": fractions,
+        "kernels": kernels[:top_n],
+        "n_events": len(events),
+    }
+
+
+def wall_clock_events(events: list[dict], t0_wall: float) -> list[dict]:
+    """Re-base profiler-clock events onto the wall clock: the earliest
+    event is pinned to the capture's ``start_trace`` wall time, preserving
+    relative offsets — the correlation ``device_kernel`` trace spans need
+    to line up with host spans in Perfetto."""
+    if not events:
+        return []
+    ts0 = min(e["ts_us"] for e in events)
+    out = []
+    for e in events:
+        out.append({**e, "ts_wall": t0_wall + (e["ts_us"] - ts0) / 1e6,
+                    "dur_s": e["dur_us"] / 1e6})
+    return out
+
+
+class ProfileSession:
+    """One ``jax.profiler`` capture: ``start()`` begins the trace (noting
+    wall time and pre-existing trace files), ``stop()`` ends it and returns
+    the parsed attribution.  Raises ``RuntimeError`` when jax is missing —
+    callers surface that as a structured error, never a crash."""
+
+    def __init__(self, profile_dir: str | Path):
+        self.dir = Path(profile_dir)
+        self.t0_wall = 0.0
+        self._preexisting: frozenset[str] = frozenset()
+        self._started = False
+
+    def start(self) -> None:
+        try:
+            import jax
+        except ImportError as exc:           # pragma: no cover - jax baked in
+            raise RuntimeError(f"profiling needs jax: {exc}") from exc
+        self.dir.mkdir(parents=True, exist_ok=True)
+        pattern = os.path.join(str(self.dir),
+                               "plugins", "profile", "*", "*.trace.json.gz")
+        self._preexisting = frozenset(glob.glob(pattern))
+        jax.profiler.start_trace(str(self.dir))
+        self.t0_wall = time.time()
+        self._started = True
+
+    def stop(self) -> dict:
+        """Stop the capture; returns ``{"attribution", "events", "trace_file",
+        "t0_wall", "duration_s"}`` with wall-mapped events.  A capture that
+        produced no trace file (profiler unavailable on this runtime)
+        returns empty attribution rather than raising."""
+        if not self._started:
+            raise RuntimeError("ProfileSession.stop() before start()")
+        import jax
+
+        t1 = time.time()
+        jax.profiler.stop_trace()
+        self._started = False
+        trace_file = find_trace_file(self.dir, self._preexisting)
+        events = parse_trace_file(trace_file) if trace_file else []
+        return {
+            "attribution": attribute_device_time(events),
+            "events": wall_clock_events(events, self.t0_wall),
+            "trace_file": str(trace_file) if trace_file else "",
+            "t0_wall": self.t0_wall,
+            "duration_s": round(t1 - self.t0_wall, 6),
+        }
+
+
+def measured_roofline(floor_s_per_call: float, kernel_s_per_call: float) -> float:
+    """The measured analog of bench's modeled ``roofline_frac``: the cost
+    model's floor time for one scoring call over the MEASURED device time
+    one call actually took.  1.0 = the kernel runs at the memory/compute
+    bound; the modeled fraction uses end-to-end wall time and so mixes in
+    host overhead this number excludes."""
+    if kernel_s_per_call <= 0 or floor_s_per_call <= 0:
+        return 0.0
+    return min(1.0, floor_s_per_call / kernel_s_per_call)

@@ -860,7 +860,8 @@ def _jit_sites(mod):
 def _jit_site_name(mod, node: ast.Call) -> str:
     """Stable registry key for one jit site: the wrapped function's name
     when resolvable (decorated def, ``jax.jit(f)``, ``jax.jit(partial(f,
-    ...))``, ``jax.jit(shard_map(f, ...))``), else the assignment target
+    ...))`` — plain or the name-preserving ``named_partial`` variant —
+    ``jax.jit(shard_map(f, ...))``), else the assignment target
     (``self._fn = jax.jit(...)`` -> ``_fn``), else the enclosing
     qualname."""
     parent = mod.parents.get(node)
@@ -871,7 +872,8 @@ def _jit_site_name(mod, node: ast.Call) -> str:
     wrapped = node.args[0] if node.args else None
     for _ in range(3):                # unwrap partial(...)/shard_map(...)
         if isinstance(wrapped, ast.Call) and \
-                _call_name(wrapped) in ("partial", "shard_map") and \
+                _call_name(wrapped) in ("partial", "named_partial",
+                                        "shard_map") and \
                 wrapped.args:
             wrapped = wrapped.args[0]
         else:

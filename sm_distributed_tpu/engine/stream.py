@@ -406,6 +406,27 @@ class StreamIngest:
                 "pixels": sum(int(c["count"]) for c in m["chunks"].values()),
                 "finished": bool(m.get("finished"))}
 
+    def in_flight(self) -> int:
+        """Acquisitions whose chunk log exists but is not yet finished —
+        the fleet-status / timeseries signal for live instrument streams.
+        Disk-derived like everything else here, so any replica answers the
+        same; a torn manifest (mid-commit) counts as in flight."""
+        n = 0
+        try:
+            entries = list(self.root.iterdir())
+        except OSError:
+            return 0
+        for d in entries:
+            if not d.is_dir():
+                continue
+            try:
+                m = json.loads((d / "manifest.json").read_text())
+            except (OSError, ValueError):
+                m = {}
+            if not m.get("finished"):
+                n += 1
+        return n
+
 
 class StreamSearchJob(SearchJob):
     """The ``mode=stream`` attempt: wait on the chunk log, re-score the

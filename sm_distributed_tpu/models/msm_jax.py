@@ -12,7 +12,7 @@ The graph compiles ONCE per dataset: formula batches are padded to the static
 
 from __future__ import annotations
 
-from functools import partial
+from functools import partial, update_wrapper
 
 import jax
 import jax.numpy as jnp
@@ -487,6 +487,18 @@ _VARIANTS = {
 }
 
 
+def named_partial(fn, **kwargs) -> partial:
+    """``partial`` that keeps ``fn``'s name, so ``jax.jit`` labels the
+    compiled program ``jit_<fn.__name__>`` instead of
+    ``jit__unnamed_wrapped_function_``.  The on-demand device profiler
+    (service/fleetview.py, ISSUE 20) attributes per-kernel device time by
+    HLO module name — an anonymous partial makes the entire scoring path
+    unattributable in /debug/profile and the roofline bench."""
+    p = partial(fn, **kwargs)
+    update_wrapper(p, fn)
+    return p
+
+
 def make_flat_jits(common: dict) -> dict:
     """The flat-path jitted scorers for one metric geometry, keyed by
     variant name.  ``common`` is the closure dict (nrows — row-bucketed
@@ -500,16 +512,16 @@ def make_flat_jits(common: dict) -> dict:
     entry a later real job looks up (ISSUE 13)."""
     return {
         "plain": jax.jit(
-            partial(fused_score_fn_flat_banded, **common),
+            named_partial(fused_score_fn_flat_banded, **common),
             static_argnames=("gc_width", "b", "k")),
         "compact": jax.jit(
-            partial(fused_score_fn_flat_banded_compact, **common),
+            named_partial(fused_score_fn_flat_banded_compact, **common),
             static_argnames=("n_keep", "gc_width", "b", "k")),
         "band": jax.jit(
-            partial(fused_score_fn_flat_banded_sliced, **common),
+            named_partial(fused_score_fn_flat_banded_sliced, **common),
             static_argnames=("w_cap", "gc_width", "b", "k")),
         "fused": jax.jit(
-            partial(fused_score_fn_flat_fused, **common),
+            named_partial(fused_score_fn_flat_fused, **common),
             static_argnames=("gc_width", "b", "k")),
     }
 
@@ -675,8 +687,8 @@ class JaxBackend:
             self._n_pix_b = ds.n_pixels
             self._n_real = None
             self._fn = jax.jit(
-                partial(fused_score_fn_chunked, **{**common,
-                                                   "nrows": ds.nrows}),
+                named_partial(fused_score_fn_chunked, **{**common,
+                                                         "nrows": ds.nrows}),
                 static_argnames=("gc_width", "b", "k"),
             )
         else:
@@ -1087,7 +1099,7 @@ class JaxBackend:
         img_cfg = self.ds_config.image_generation
         ext_statics = {kk: v for kk, v in statics.items()
                        if kk in ("n_keep", "w_cap", "gc_width")}
-        ext_fn = jax.jit(partial(
+        ext_fn = jax.jit(named_partial(
             ext_base, n_pixels=self._n_pix_b, **ext_statics))
         # extraction args = everything before (theor_ints, n_valid[,
         # n_real]); the trailing ``inv`` is the ION un-permutation consumed
@@ -1117,7 +1129,7 @@ class JaxBackend:
         mom_fn = jax.jit(batch_moments)
         phases["moments"] = lambda: mom_fn(imgs, n_real_d)
         _sums, _normsq, _dots, _vmax, _nn = mom_fn(imgs, n_real_d)
-        chaos_fn = jax.jit(partial(
+        chaos_fn = jax.jit(named_partial(
             measure_of_chaos_batch, nrows=self._nrows_b, ncols=self.ds.ncols,
             nlevels=img_cfg.nlevels))
         phases["chaos"] = lambda: chaos_fn(
@@ -1167,7 +1179,8 @@ class JaxBackend:
                 # below takes the exact-pixel prefix, so the export is
                 # bit-identical while the executable is shared per bucket
                 self._extract_fn = jax.jit(
-                    partial(extract_images_flat, n_pixels=self._n_pix_b))
+                    named_partial(extract_images_flat,
+                                  n_pixels=self._n_pix_b))
             pos = flat_bound_ranks(self._mz_host, grid)
             imgs = self._extract_fn(
                 self._px_s, self._in_f32(), jax.device_put(pos),

@@ -49,6 +49,19 @@ RabbitMQ's management UI):
   per-chip health state + fault strikes + quarantine evidence
   (``service/health.py``), lease holders, probe/quarantine/readmit/
   host-eviction totals, and per-chip breaker states;
+- ``GET /fleet/metrics`` / ``GET /fleet/slo`` / ``GET /fleet/status``
+  the fleet observability plane (ISSUE 20, ``service/fleetview.py``):
+  every live replica's exposition merged into one pane (counters summed,
+  gauges re-labelled ``{replica=}``, histograms bucket-merged),
+  fleet-wide SLO attainment from the merged buckets, and the replica /
+  host / pool / stream roll-up — peer scrape failures degrade to a
+  partial view with ``sm_fleetview_scrape_errors_total{replica=}``
+  evidence, never a 500;
+- ``GET /debug/profile?seconds=``  single-flight on-demand
+  ``jax.profiler`` capture around in-flight work: per-kernel device-time
+  attribution (fused scoring kernel vs gather/segment-sum chain vs
+  transfers) + ``device_kernel`` spans injected into running jobs'
+  traces (409 while another capture runs);
 - ``GET /datasets`` / ``GET /datasets/<id>/annotations`` /
   ``GET /annotations`` / ``GET /datasets/<id>/images/<sf_adduct>``  the
   result read path (ISSUE 16, ``service/readpath.py``): dataset listing,
@@ -204,6 +217,28 @@ class AdminAPI:
                         self._reply_json(status, body)
                     elif url.path == "/slo":
                         status, body = api._slo()
+                        self._reply_json(status, body)
+                    elif url.path == "/fleet/metrics":
+                        status, text = api._fleet_metrics()
+                        self._reply(status, text.encode(),
+                                    "text/plain; version=0.0.4")
+                    elif url.path == "/fleet/slo":
+                        status, body = api._fleet_slo()
+                        self._reply_json(status, body)
+                    elif url.path == "/fleet/status":
+                        status, body = api._fleet_status()
+                        self._reply_json(status, body)
+                    elif url.path == "/debug/profile":
+                        q = parse_qs(url.query)
+                        s = q.get("seconds", [None])[0]
+                        try:
+                            seconds = float(s) if s else None
+                        except ValueError:
+                            self._reply_json(
+                                400, {"error": "'seconds' must be a number",
+                                      "reason": "invalid_request"})
+                            return
+                        status, body = api._profile(seconds)
                         self._reply_json(status, body)
                     elif url.path == "/peers":
                         self._reply_json(200, api._peers())
@@ -595,6 +630,45 @@ class AdminAPI:
             return 404, {"error": "SLO tracker not configured",
                          "reason": "not_found"}
         return 200, slo.report()
+
+    def _fleet_metrics(self) -> tuple[int, str]:
+        """``GET /fleet/metrics`` (ISSUE 20) — every live replica's
+        exposition merged into one: counters summed, gauges re-labelled
+        ``{replica=}``, histograms bucket-merged.  Peer failures degrade
+        to a partial view with evidence comments, never an error."""
+        fv = getattr(self.service, "fleetview", None)
+        if fv is None:
+            return 404, "# fleetview not configured (service.fleetview)\n"
+        return 200, fv.metrics_text()
+
+    def _fleet_slo(self) -> tuple[int, dict]:
+        """``GET /fleet/slo`` — fleet-wide attainment / error-budget burn
+        for the five SLIs, computed from the merged histogram buckets."""
+        fv = getattr(self.service, "fleetview", None)
+        if fv is None:
+            return 404, {"error": "fleetview not configured",
+                         "reason": "not_found"}
+        return fv.slo()
+
+    def _fleet_status(self) -> tuple[int, dict]:
+        """``GET /fleet/status`` — replicas, hosts, evictions, pool
+        occupancy, in-flight stream acquisitions, scrape evidence."""
+        fv = getattr(self.service, "fleetview", None)
+        if fv is None:
+            return 404, {"error": "fleetview not configured",
+                         "reason": "not_found"}
+        return fv.status()
+
+    def _profile(self, seconds: float | None) -> tuple[int, dict]:
+        """``GET /debug/profile?seconds=`` (ISSUE 20) — single-flight
+        ``jax.profiler`` capture around in-flight work: per-kernel device
+        time attribution + ``device_kernel`` span injection into running
+        jobs' traces.  409 while another capture runs."""
+        prof = getattr(self.service, "profiler", None)
+        if prof is None:
+            return 404, {"error": "device profiler not configured",
+                         "reason": "not_found"}
+        return prof.run(seconds)
 
     def _cancel(self, msg_id: str) -> tuple[int, dict]:
         disposition = self.service.scheduler.cancel(msg_id)

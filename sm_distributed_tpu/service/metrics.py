@@ -196,6 +196,22 @@ class _HistogramChild:
         with self._lock:
             return list(self.counts), self.sum, self.count
 
+    def merge(self, counts: list[int], sum_: float, count: int) -> None:
+        """Fold another child's snapshot into this one.  Bucket counts are
+        integers, so merging is exact: merged counts equal observing the
+        union of both sample sets (the fleet-view equivalence the property
+        test pins).  The float ``sum`` is added once per merge — the same
+        order-of-one addition a single observer would have performed."""
+        if len(counts) != len(self.buckets):
+            raise ValueError(
+                f"histogram merge: {len(counts)} bucket counts into "
+                f"{len(self.buckets)} buckets")
+        with self._lock:
+            for i, n in enumerate(counts):
+                self.counts[i] += n
+            self.sum += sum_
+            self.count += count
+
     def fraction_below(self, threshold: float) -> tuple[float, int]:
         """(fraction of observations <= threshold, total count) — the SLO
         attainment primitive.  Exact at bucket boundaries; inside a bucket
@@ -246,6 +262,26 @@ class Histogram(_Metric):
             lines.append(f"{self.name}_sum{_fmt_labels(base)} {_fmt_value(total_sum)}")
             lines.append(f"{self.name}_count{_fmt_labels(base)} {count}")
         return lines
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold ``other``'s children into this family, creating children for
+        label sets seen only on ``other``.  Equivalent to having observed the
+        union of both families' samples: bucket counts and totals add as
+        integers, sums add once per child.  Bucket boundaries must match —
+        merging across different schemas has no exact meaning."""
+        if tuple(other.buckets) != tuple(self.buckets):
+            raise ValueError(
+                f"histogram merge: bucket mismatch {other.buckets} vs "
+                f"{self.buckets}")
+        with other._lock:
+            src = list(other._children.items())
+        for key, child in src:
+            counts, sum_, count = child.snapshot()
+            with self._lock:
+                dst = self._children.get(key)
+                if dst is None:
+                    dst = self._children[key] = self._make_child()
+            dst.merge(counts, sum_, count)
 
     def fraction_below(self, threshold: float) -> tuple[float, int]:
         """Aggregate ``fraction_below`` across all children (SLO helper)."""
@@ -389,6 +425,18 @@ class MetricsRegistry:
 
     def histogram(self, name, help="", labelnames=(), buckets=DEFAULT_BUCKETS) -> Histogram:
         return self._register(Histogram(name, help, labelnames, buckets))
+
+    def value(self, name: str) -> float | None:
+        """Summed child values of an existing counter/gauge family, or
+        ``None`` when the family was never registered — the scrape-free
+        read the telemetry snapshot ring uses."""
+        with self._lock:
+            m = self._metrics.get(name)
+        if not isinstance(m, (Counter, Gauge)):
+            return None
+        with m._lock:
+            children = list(m._children.values())
+        return float(sum(c.value for c in children))
 
     def add_collector(self, fn) -> None:
         """``fn(registry)`` runs at each scrape BEFORE exposition — the hook

@@ -393,6 +393,12 @@ class JobScheduler:
         self._threads: list[threading.Thread] = []
         self._inflight_by_tenant: dict[str, int] = {}
         self._terminal_count = 0
+        # heartbeat gossip suppliers (ISSUE 20): the server registers
+        # callables (admin address, pool occupancy, stream in-flight) whose
+        # values fold into every registry beat so peers can discover this
+        # replica's admin API and fleet status without another channel.
+        # Written once at wiring time, read by the replica beat loop.
+        self._gossip: dict[str, object] = {}
         self._started = False
         if metrics is not None:
             self._init_metrics(metrics)
@@ -1482,7 +1488,22 @@ class JobScheduler:
                    "host": self.identity["host"]}
         if self.admission is not None:
             s["admission"] = self.admission.stats()
+        # fleet-view gossip (ISSUE 20): admin address / pool occupancy /
+        # stream in-flight suppliers, each exception-safe — a broken
+        # supplier must not stop the heartbeat (losing the beat would look
+        # like replica death and trigger takeover)
+        for key, fn in self._gossip.items():
+            try:
+                s[key] = fn() if callable(fn) else fn
+            except Exception:
+                logger.warning("scheduler: gossip supplier %r failed", key,
+                               exc_info=True)
         return s
+
+    def add_gossip(self, key: str, supplier) -> None:
+        """Register a heartbeat gossip field: ``supplier()`` (or a constant)
+        is folded into every ``_beat_summary``.  Wire-time only."""
+        self._gossip[key] = supplier
 
     # -------------------------------------------------------- host watchdog
     def _host_watchdog(self, now: float) -> None:

@@ -172,7 +172,8 @@ class AnnotationService:
             device_pool=self.device_pool,
             queue_root=self.queue_dir / queue,
             compile_cache_dir=compile_cache_path(self.sm_config),
-            replica_id=cfg.replica_id)
+            replica_id=cfg.replica_id,
+            readpath=self.readpath, stream_ingest=self.stream_ingest)
         # device-backend circuit breaker: configure the process singleton
         # from THIS service's knobs and export its state on /metrics
         get_device_breaker(cfg)
@@ -207,6 +208,30 @@ class AnnotationService:
             self.metrics.add_collector(self._collect_residency)
         self.api = AdminAPI(self, host=cfg.http_host,
                             port=cfg.http_port) if with_api else None
+        # fleet observability plane (ISSUE 20, service/fleetview.py):
+        # /fleet/* aggregation across live replicas + /debug/profile
+        # on-demand device capture.  The admin address, pool occupancy and
+        # in-flight stream count are gossiped through registry heartbeats
+        # so peers can scrape this replica without another channel — the
+        # API binds its socket in __init__, so the address is final here.
+        from .fleetview import DeviceProfiler, FleetView
+
+        self.fleetview = (FleetView(self, cfg.fleetview)
+                          if cfg.fleetview.enabled and with_api else None)
+        self.profiler = DeviceProfiler(self, self.sm_config.telemetry.profile)
+        if self.api is not None:
+            self.scheduler.add_gossip(
+                "admin", lambda: "%s:%d" % self.api.address)
+        self.scheduler.add_gossip("pool", self._gossip_pool)
+        self.scheduler.add_gossip("streams_in_flight",
+                                  self.stream_ingest.in_flight)
+
+    def _gossip_pool(self) -> dict:
+        """The heartbeat-sized pool summary peers fold into /fleet/status
+        (the full per-chip view stays on this replica's /debug/devices)."""
+        snap = self.device_pool.snapshot()
+        return {"size": snap["size"], "in_use": snap["in_use"],
+                "waiters": snap["waiters"]}
 
     # -------------------------------------------------------------- metrics
     def _observe_phase(self, phase: str, seconds: float) -> None:

@@ -60,7 +60,8 @@ class DeviceMonitor:
     def __init__(self, registry, cfg: TelemetryConfig | None = None,
                  device_token=None, queue_root: str | Path | None = None,
                  compile_cache_dir: str | Path | None = None,
-                 device_pool=None, replica_id: str = ""):
+                 device_pool=None, replica_id: str = "",
+                 readpath=None, stream_ingest=None):
         self.registry = registry
         self.cfg = cfg or TelemetryConfig()
         # replica identity (ISSUE 8): stamped on every timeseries sample so
@@ -78,6 +79,11 @@ class DeviceMonitor:
         self.queue_root = Path(queue_root) if queue_root else None
         self.compile_cache_dir = (Path(compile_cache_dir)
                                   if compile_cache_dir else None)
+        # PR 16/19 planes (ISSUE 20 satellite): the read path's cache /
+        # in-flight state and the stream ingest's chunk counters sample
+        # into the ring too, so fleet status can chart them over time
+        self.readpath = readpath
+        self.stream_ingest = stream_ingest
         self._ring: deque = deque(maxlen=self.cfg.timeseries_len)
         self._occ: deque = deque(maxlen=_OCCUPANCY_WINDOW)
         self._lock = threading.Lock()
@@ -253,6 +259,30 @@ class DeviceMonitor:
                     list(self.queue_root.glob("pending/*.json")))
                 snap["queue_running"] = len(
                     list(self.queue_root.glob("running/*.json")))
+            except OSError:
+                pass
+        # PR 16 read plane (ISSUE 20 satellite): cache + in-flight state,
+        # so /debug/timeseries charts read saturation beside device state
+        if self.readpath is not None:
+            rp = self.readpath.snapshot()
+            cache = rp.get("cache", {})
+            snap["read_inflight"] = rp.get("inflight")
+            snap["read_sheds"] = rp.get("sheds")
+            snap["read_cache_hits"] = cache.get("hits")
+            snap["read_cache_misses"] = cache.get("misses")
+            snap["read_cache_bytes"] = cache.get("bytes")
+            snap["read_cache_entries"] = cache.get("entries")
+        # PR 19 stream plane: chunk/pixel/re-rank totals (from the shared
+        # registry) + acquisitions currently open on the shared stream root
+        if self.stream_ingest is not None:
+            snap["stream_chunks_total"] = self.registry.value(
+                "sm_stream_chunks_total")
+            snap["stream_pixels_total"] = self.registry.value(
+                "sm_stream_pixels_total")
+            snap["stream_reranks_total"] = self.registry.value(
+                "sm_stream_reranks_total")
+            try:
+                snap["stream_in_flight"] = self.stream_ingest.in_flight()
             except OSError:
                 pass
         with self._lock:

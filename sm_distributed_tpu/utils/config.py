@@ -389,6 +389,28 @@ class StreamConfig:
 
 
 @dataclass(frozen=True)
+class FleetViewConfig:
+    """Fleet observability plane (ISSUE 20, service/fleetview.py,
+    docs/OBSERVABILITY.md "Fleet plane"): the serving replica scrapes live
+    peers (admin addresses gossiped through registry heartbeats), merges
+    their exposition, and answers ``GET /fleet/metrics|slo|status`` with a
+    fleet-wide view that degrades to partial-with-evidence when a peer dies
+    mid-scrape."""
+    enabled: bool = True                 # serve the /fleet/* endpoints
+    scrape_timeout_s: float = 2.0        # per-peer HTTP scrape budget; a
+                                         # peer slower than this counts as a
+                                         # scrape error, not a fleet 500
+    cache_ttl_s: float = 1.0             # merged-view reuse window so N
+                                         # dashboard readers cost one fleet
+                                         # scrape (0 = scrape every request)
+
+    def __post_init__(self):
+        if self.scrape_timeout_s <= 0 or self.cache_ttl_s < 0:
+            raise ValueError("fleetview: scrape_timeout_s must be positive "
+                             "and cache_ttl_s >= 0")
+
+
+@dataclass(frozen=True)
 class ServiceConfig:
     """Annotation-service knobs (scheduler + failure policy + admin API) —
     the serving-side analog of the reference's rabbitmq/daemon settings.
@@ -503,6 +525,7 @@ class ServiceConfig:
     prime: PrimeConfig = field(default_factory=PrimeConfig)
     read: ReadPathConfig = field(default_factory=ReadPathConfig)
     stream: StreamConfig = field(default_factory=StreamConfig)
+    fleetview: FleetViewConfig = field(default_factory=FleetViewConfig)
 
     def __post_init__(self):
         if self.workers <= 0 or self.max_attempts <= 0:
@@ -544,6 +567,27 @@ class ServiceConfig:
 
 
 @dataclass(frozen=True)
+class ProfileConfig:
+    """On-demand device profiling (ISSUE 20, service/fleetview.py,
+    docs/OBSERVABILITY.md "Device profiles"): ``GET /debug/profile?seconds=``
+    runs a ``jax.profiler`` capture around in-flight work, attributes device
+    time per kernel, and injects ``device_kernel`` spans into live job
+    traces."""
+    enabled: bool = True                 # serve /debug/profile
+    default_seconds: float = 2.0         # capture window when ?seconds= is
+                                         # omitted
+    max_seconds: float = 30.0            # hard cap on a requested window (a
+                                         # profile holds the single-flight
+                                         # slot for its whole duration)
+    dir: str = ""                        # capture dir; "" = <work_dir>/profiles
+
+    def __post_init__(self):
+        if not 0 < self.default_seconds <= self.max_seconds:
+            raise ValueError("profile: need 0 < default_seconds <= "
+                             "max_seconds")
+
+
+@dataclass(frozen=True)
 class TelemetryConfig:
     """Quantitative telemetry (service/telemetry.py, docs/OBSERVABILITY.md):
     the device/HBM monitor + metric-snapshot time-series ring behind
@@ -564,6 +608,7 @@ class TelemetryConfig:
     slo_stream_partial_s: float = 30.0   # stream chunk commit -> provisional
                                          # partial served (ISSUE 19)
     slo_target: float = 0.99
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
 
     def __post_init__(self):
         if self.sample_interval_s <= 0 or self.timeseries_len <= 0:
@@ -758,4 +803,6 @@ _DATACLASS_FIELDS = {
     ("ServiceConfig", "prime"): PrimeConfig,
     ("ServiceConfig", "read"): ReadPathConfig,
     ("ServiceConfig", "stream"): StreamConfig,
+    ("ServiceConfig", "fleetview"): FleetViewConfig,
+    ("TelemetryConfig", "profile"): ProfileConfig,
 }

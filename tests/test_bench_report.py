@@ -39,6 +39,9 @@ def test_report_schema_and_values():
         "roofline_frac", "roofline_floor_s", "roofline_bound",
         "fused", "cube_dtype", "resident_cube_bytes",
         "resident_cube_bytes_f32",
+        # ISSUE 20: profiler-measured roofline (device time attributed to
+        # the scoring kernels by HLO module name, not wall-clock)
+        "measured_roofline_frac", "kernel_time_frac", "device_kernel_s",
     }
     # per-phase wall (ISSUE 5 satellite): the trajectory explains WHERE
     # time moved; stream_s appears only when the case config is passed

@@ -1,0 +1,288 @@
+"""Fleet observability plane (ISSUE 20): exposition parse/merge semantics,
+fleet SLO bit-equality with a single tracker observing the union, the
+partial-view-with-evidence contract when a peer is unreachable, and the
+device profiler's request-validation paths.
+
+The 3-replica live-fleet behavior (mid-scrape SIGKILL, profile capture
+during a sharded job) is gated end-to-end by scripts/fleet_smoke.py; these
+tests pin the pure logic those gates are built on.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from sm_distributed_tpu.service.fleetview import (  # noqa: E402
+    DeviceProfiler,
+    FleetView,
+    merge_expositions,
+    parse_exposition,
+    slo_report_from_registry,
+)
+from sm_distributed_tpu.service.leases import ReplicaRegistry  # noqa: E402
+from sm_distributed_tpu.service.metrics import (  # noqa: E402
+    MetricsRegistry,
+)
+from sm_distributed_tpu.service.telemetry import SLOTracker  # noqa: E402
+from sm_distributed_tpu.utils.config import (  # noqa: E402
+    FleetViewConfig,
+    ProfileConfig,
+    TelemetryConfig,
+)
+
+
+def _dyadic(rng: random.Random) -> float:
+    # multiples of 1/1024 add exactly in binary floating point, so summed
+    # histogram `sum` fields are bit-equal however the adds are grouped
+    return rng.randrange(0, 8192) / 1024.0
+
+
+# ------------------------------------------------------------------ parsing
+def test_parse_exposition_roundtrip():
+    reg = MetricsRegistry()
+    c = reg.counter("sm_x_jobs_total", "jobs", ("state",))
+    c.labels(state="done").inc(3)
+    c.labels(state="failed").inc(1)
+    reg.gauge("sm_x_depth", "queue depth").set(7.5)
+    h = reg.histogram("sm_x_wait_seconds", "waits")
+    h.observe(0.3)
+    h.observe(2.0)
+
+    fams = parse_exposition(reg.expose())
+    assert fams["sm_x_jobs_total"]["kind"] == "counter"
+    assert fams["sm_x_depth"]["kind"] == "gauge"
+    assert fams["sm_x_wait_seconds"]["kind"] == "histogram"
+    counter_vals = {tuple(sorted(lab.items())): v
+                    for suffix, lab, v in fams["sm_x_jobs_total"]["samples"]
+                    if suffix == ""}
+    assert counter_vals[(("state", "done"),)] == 3.0
+    assert counter_vals[(("state", "failed"),)] == 1.0
+    # histogram series resolve to their suffixes, +Inf bucket == count
+    suffixes = {s for s, _, _ in fams["sm_x_wait_seconds"]["samples"]}
+    assert suffixes == {"_bucket", "_sum", "_count"}
+    inf = [v for s, lab, v in fams["sm_x_wait_seconds"]["samples"]
+           if s == "_bucket" and lab.get("le") == "+Inf"]
+    assert inf == [2.0]
+
+
+def test_parse_exposition_skips_garbage_lines():
+    text = ("# TYPE sm_ok_total counter\n"
+            "sm_ok_total 4\n"
+            "this line is not exposition at all {{{\n"
+            "sm_no_value{label=\"x\"}\n")
+    fams = parse_exposition(text)
+    assert fams["sm_ok_total"]["samples"] == [("", {}, 4.0)]
+
+
+# ------------------------------------------------------------------ merging
+def test_merge_counters_summed_gauges_relabelled():
+    scrapes = {}
+    for rid, jobs, depth in (("r0", 5, 2.0), ("r1", 7, 9.0)):
+        reg = MetricsRegistry()
+        reg.counter("sm_y_jobs_total", "jobs").inc(jobs)
+        reg.gauge("sm_y_depth", "depth").set(depth)
+        scrapes[rid] = reg.expose()
+
+    merged = merge_expositions(scrapes)
+    text = merged.expose()
+    # counters: one fleet total
+    assert "sm_y_jobs_total 12" in text
+    # gauges: one series per replica, re-labelled — a fleet-summed gauge
+    # would be meaningless (occupancy, depth are per-replica states)
+    assert 'sm_y_depth{replica="r0"} 2' in text
+    assert 'sm_y_depth{replica="r1"} 9' in text
+
+
+def test_merge_histograms_bit_equal_with_observing_union():
+    rng = random.Random(20)
+    per_replica = {f"r{i}": [_dyadic(rng) for _ in range(200)]
+                   for i in range(3)}
+
+    scrapes = {}
+    for rid, samples in per_replica.items():
+        reg = MetricsRegistry()
+        h = reg.histogram("sm_z_lat_seconds", "lat")
+        for s in samples:
+            h.observe(s)
+        scrapes[rid] = reg.expose()
+
+    union = MetricsRegistry()
+    hu = union.histogram("sm_z_lat_seconds", "lat")
+    for samples in per_replica.values():
+        for s in samples:
+            hu.observe(s)
+
+    merged = merge_expositions(scrapes)
+    hm = merged._metrics["sm_z_lat_seconds"]
+    for thr in (0.1, 1.0, 5.0, 1e9):
+        assert hm.fraction_below(thr) == hu.fraction_below(thr)
+    # the merged exposition's histogram series are identical too
+    def series(reg):
+        return sorted(line for line in reg.expose().splitlines()
+                      if line.startswith("sm_z_lat_seconds"))
+    assert series(merged) == series(union)
+
+
+# ---------------------------------------------------------------- fleet SLO
+def test_fleet_slo_bit_equal_with_single_tracker_on_union():
+    """slo_report_from_registry over merged scrapes == SLOTracker.report of
+    one tracker that observed every replica's samples — the /fleet/slo
+    bit-equality contract the smoke gate re-checks live."""
+    rng = random.Random(21)
+    cfg = TelemetryConfig()
+
+    union_reg = MetricsRegistry()
+    union_tracker = SLOTracker(union_reg, cfg)
+
+    scrapes = {}
+    for rid in ("r0", "r1", "r2"):
+        reg = MetricsRegistry()
+        tracker = SLOTracker(reg, cfg)
+        for _ in range(150):
+            v = _dyadic(rng)
+            tracker.h_queue_wait.observe(v)
+            union_tracker.h_queue_wait.observe(v)
+        for _ in range(80):
+            v = _dyadic(rng)
+            tracker.h_e2e.observe(v)
+            union_tracker.h_e2e.observe(v)
+        for _ in range(40):
+            v = _dyadic(rng)
+            tracker.h_read.observe(v)
+            union_tracker.h_read.observe(v)
+        scrapes[rid] = reg.expose()
+    # first_annotation / stream_partial stay empty: count==0 SLIs must
+    # report attainment None on both sides, not crash either
+
+    merged = merge_expositions(scrapes)
+    fleet = slo_report_from_registry(merged, cfg)
+    single = union_tracker.report()
+    assert fleet == single
+    assert fleet["slos"]["first_annotation"]["attainment"] is None
+    assert fleet["slos"]["queue_wait"]["count"] == 450
+
+
+# ------------------------------------------- partial view, never an error
+def _fake_service(tmp_path, rid="r0"):
+    reg = MetricsRegistry()
+    reg.counter("sm_fake_jobs_total", "jobs").inc(2)
+    registry = ReplicaRegistry(tmp_path, rid, stale_after_s=8.0)
+    registry.register()
+    sched = types.SimpleNamespace(
+        replica_id=rid, registry=registry, _evicted_hosts=set(),
+        jobs=lambda: [])
+    svc = types.SimpleNamespace(
+        metrics=reg, scheduler=sched,
+        sm_config=types.SimpleNamespace(
+            telemetry=TelemetryConfig(), work_dir=str(tmp_path)),
+        trace_dir=None)
+    return svc
+
+
+def _closed_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_fleetview_partial_view_with_dead_peer(tmp_path):
+    """An alive-looking peer whose admin endpoint is gone (killed between
+    heartbeats) yields a 200 partial view with per-replica evidence and a
+    bumped sm_fleetview_scrape_errors_total — never a 500."""
+    svc = _fake_service(tmp_path)
+    # fake peer: fresh heartbeat (alive=True) but its admin port is closed
+    peer = ReplicaRegistry(tmp_path, "r_dead", stale_after_s=8.0)
+    peer.register()
+    peer.beat({"admin": f"127.0.0.1:{_closed_port()}", "host": "host-b"})
+    # and one peer that never gossiped an admin address at all
+    legacy = ReplicaRegistry(tmp_path, "r_legacy", stale_after_s=8.0)
+    legacy.register()
+
+    # one shared round across the endpoint calls below (cache_ttl_s), so
+    # the evidence counter's value stays the single failed scrape's
+    fv = FleetView(svc, FleetViewConfig(scrape_timeout_s=0.5,
+                                        cache_ttl_s=60.0))
+    rnd = fv.collect(force=True)
+
+    assert rnd.partial
+    assert set(rnd.scrape_errors) == {"r_dead", "r_legacy"}
+    assert "no admin address gossiped" in rnd.scrape_errors["r_legacy"]
+    assert rnd.replicas["r_dead"]["alive"]
+    assert rnd.replicas["r_dead"]["scraped"] is False
+    assert rnd.replicas["r0"]["scraped"] is True
+
+    code, slo = fv.slo()
+    assert code == 200
+    assert slo["fleet"]["partial"] is True
+    assert slo["fleet"]["replicas_merged"] == 1
+    assert slo["fleet"]["replicas_known"] == 3
+    assert "r_dead" in slo["fleet"]["scrape_errors"]
+
+    text = fv.metrics_text()
+    assert "# fleetview: merged 3 replica(s), partial=true" in text
+    assert "# fleetview: scrape of r_dead failed:" in text
+    # local families still merged (self-scrape cannot fail)
+    assert "sm_fake_jobs_total 2" in text
+    # evidence counter carries the peer label
+    assert 'sm_fleetview_scrape_errors_total{replica="r_dead"} 1' \
+        in svc.metrics.expose()
+
+    code, status = fv.status()
+    assert code == 200
+    assert status["partial"] is True
+    assert status["alive"] == 3
+    assert status["hosts"].get("host-b") == ["r_dead"]
+
+
+def test_fleetview_cache_reuses_round(tmp_path):
+    svc = _fake_service(tmp_path)
+    fv = FleetView(svc, FleetViewConfig(cache_ttl_s=60.0))
+    r1 = fv.collect()
+    r2 = fv.collect()
+    assert r2 is r1
+    assert fv.collect(force=True) is not r1
+
+
+# ------------------------------------------------------------ profiler API
+def test_profiler_validation_paths(tmp_path):
+    svc = _fake_service(tmp_path)
+
+    disabled = DeviceProfiler(svc, ProfileConfig(enabled=False))
+    code, body = disabled.run(1.0)
+    assert code == 404 and body["reason"] == "not_found"
+
+    prof = DeviceProfiler(svc, ProfileConfig(max_seconds=5.0))
+    code, body = prof.run(-1.0)
+    assert code == 400 and body["reason"] == "invalid_request"
+    code, body = prof.run(0)
+    assert code == 400
+
+    # single-flight: a held capture lock means 409, never a queued stall
+    assert prof._busy.acquire(blocking=False)
+    try:
+        code, body = prof.run(0.1)
+        assert code == 409 and body["reason"] == "busy"
+    finally:
+        prof._busy.release()
+
+
+@pytest.mark.slow
+def test_profiler_capture_smoke(tmp_path):
+    """A real (idle) capture returns 200 with a trace file or an empty
+    attribution — never an exception."""
+    svc = _fake_service(tmp_path)
+    prof = DeviceProfiler(svc, ProfileConfig(default_seconds=0.2))
+    code, body = prof.run(0.2)
+    assert code == 200
+    assert body["seconds"] == 0.2
+    assert "attribution" in body and "injected_spans" in body

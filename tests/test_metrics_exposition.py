@@ -165,6 +165,102 @@ def test_concurrent_observe_vs_scrape_consistency():
     assert not errors, errors[:5]
 
 
+# ----------------------------------------------------------- histogram merge
+def test_histogram_merge_equals_observing_the_union():
+    """Fleet-view equivalence (ISSUE 20 satellite): merging N replicas'
+    histograms is bit-equal to one histogram that observed the union of all
+    samples.  Samples are dyadic (multiples of 1/1024) so the float sums are
+    exact under any addition order; bucket counts are integers and exact by
+    construction."""
+    buckets = (0.125, 0.5, 1.0, 4.0)
+    rng = random.Random(20)
+    replicas = [[rng.randrange(0, 8192) / 1024.0 for _ in range(rng.randrange(0, 200))]
+                for _ in range(4)]
+
+    regs = [MetricsRegistry() for _ in replicas]
+    for reg, samples in zip(regs, replicas):
+        h = reg.histogram("sm_merge_seconds", "hist", ("sli",), buckets=buckets)
+        for i, v in enumerate(samples):
+            h.labels(sli="queue" if i % 3 else "e2e").observe(v)
+
+    union_reg = MetricsRegistry()
+    union = union_reg.histogram("sm_merge_seconds", "hist", ("sli",),
+                                buckets=buckets)
+    for samples in replicas:
+        for i, v in enumerate(samples):
+            union.labels(sli="queue" if i % 3 else "e2e").observe(v)
+
+    merged_reg = MetricsRegistry()
+    merged = merged_reg.histogram("sm_merge_seconds", "hist", ("sli",),
+                                  buckets=buckets)
+    for reg in regs:
+        merged.merge(reg._metrics["sm_merge_seconds"])
+
+    for key in union._children:
+        uc, us, un = union._children[key].snapshot()
+        mc, ms, mn = merged._children[key].snapshot()
+        assert mc == uc                  # integer bucket counts: bit-equal
+        assert ms == us                  # dyadic sums: bit-equal floats
+        assert mn == un
+    assert set(merged._children) == set(union._children)
+    # the SLO primitive agrees bit-for-bit too
+    for thr in (0.125, 0.3, 1.0, 99.0):
+        assert merged.fraction_below(thr) == union.fraction_below(thr)
+
+
+def test_histogram_merge_rejects_bucket_mismatch():
+    a = MetricsRegistry().histogram("sm_mm_seconds", "h", buckets=(1.0, 2.0))
+    b = MetricsRegistry().histogram("sm_mm_seconds", "h", buckets=(1.0, 3.0))
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+def test_histogram_merge_vs_concurrent_observe_keeps_inf_monotone():
+    """Merging into a histogram that live observers are writing to must
+    preserve every exposition invariant: cumulative buckets monotone and
+    +Inf == _count (a torn merge would tear them exactly like the torn
+    observe the child lock exists to prevent)."""
+    m = MetricsRegistry()
+    h = m.histogram("sm_mrace_seconds", "hist", buckets=(0.001, 0.01, 0.1, 1.0))
+    src_reg = MetricsRegistry()
+    src = src_reg.histogram("sm_mrace_seconds", "hist",
+                            buckets=(0.001, 0.01, 0.1, 1.0))
+    for i in range(500):
+        src.observe((i % 1024) / 1024.0)
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def observe():
+        rng = random.Random(7)
+        while not stop.is_set():
+            h.observe(rng.random() * 2.0)
+
+    threads = [threading.Thread(target=observe, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        merges = 0
+        for _ in range(40):
+            h.merge(src)
+            merges += 1
+            buckets, count, _total = _parse_histogram(
+                m.expose(), "sm_mrace_seconds")
+            values = [n for _le, n in buckets]
+            if values != sorted(values):
+                errors.append(f"non-monotone buckets: {buckets}")
+            if buckets[-1][1] != count:
+                errors.append(f"+Inf {buckets[-1][1]} != count {count}")
+            if count < merges * 500:
+                errors.append(
+                    f"merge lost observations: {count} < {merges * 500}")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+    assert not errors, errors[:5]
+
+
 # ----------------------------------------------------- collector dispatch
 def test_failing_collector_cannot_break_the_scrape():
     m = MetricsRegistry()
